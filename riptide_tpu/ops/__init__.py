@@ -1,0 +1,22 @@
+"""
+TPU-native compute kernels for the FFA search.
+
+This package plays the role of the reference's C++ compute core
+(riptide/cpp/): every hot numerical operation — the FFA fold tree, boxcar
+matched-filter S/N, real-factor downsampling and running medians — is
+implemented as XLA/Pallas programs planned on the host and executed on
+device. :mod:`riptide_tpu.ops.reference` holds the pure-numpy oracles the
+kernels are verified against.
+"""
+from . import reference
+from .plan import ffa_plan, batch_plans, num_levels, FFAPlan, FFABatchPlan
+from .ffa import ffa2, ffa1, ffafreq, ffaprd, ffa_levels
+from .snr import boxcar_snr, boxcar_coeffs, snr_batched
+from .downsample import (
+    split_prefix_sums,
+    downsample_gather,
+    downsample_plan_padded,
+    downsampled_size,
+    downsampled_variance,
+)
+from .running_median import running_median_jax, scrunch_jax, fast_running_median_jax

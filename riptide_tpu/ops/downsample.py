@@ -1,0 +1,84 @@
+"""
+Real-factor downsampling on TPU.
+
+The reference downsamples by a real-valued factor f with fractional
+boundary samples split by linear weights, always starting from the
+*original* time series for each factor in the periodogram cascade
+(riptide/cpp/downsample.hpp:44-82, riptide/cpp/periodogram.hpp:162-168).
+
+The TPU formulation precomputes one prefix sum of the input and turns
+every downsampling of the cascade into pure gathers:
+
+    out[k] = wmin[k]*x[imin[k]] + (cs[imax[k]] - cs[imin[k]+1])
+           + wmax[k]*x[imax[k]]
+
+with the (imin, imax, wmin, wmax) plans built host-side in float64
+(:func:`riptide_tpu.ops.reference.downsample_indices`). The prefix sum is
+computed once per search in float64 on the host and shipped as a hi/lo
+float32 pair; differences of nearby prefix values then cancel in the hi
+part with error relative to the *difference* (Sterbenz-style), and the lo
+part restores the float64 residual — giving ~float64 accuracy from pure
+float32 TPU arithmetic. This both fixes the fp32 cancellation hazard and
+makes every cascade cycle O(n) gathers instead of an O(N) re-scan.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from .reference import downsample_indices, downsampled_size, downsampled_variance
+
+__all__ = [
+    "split_prefix_sums",
+    "downsample_gather",
+    "downsample_plan_padded",
+    "downsampled_size",
+    "downsampled_variance",
+]
+
+
+def split_prefix_sums(data):
+    """
+    Host-side: inclusive prefix sum of ``data`` with a leading 0, computed
+    in float64 and split into (hi, lo) float32 arrays with
+    hi + lo ~= exact sum. Length is ``data.size + 1``.
+    """
+    cs = np.concatenate(([0.0], np.cumsum(np.asarray(data, dtype=np.float64))))
+    hi = cs.astype(np.float32)
+    lo = (cs - hi).astype(np.float32)
+    return hi, lo
+
+
+def downsample_plan_padded(nsamp, f, nout):
+    """
+    Host-side downsampling plan by factor f, padded to ``nout`` output
+    samples (padding entries produce exact zeros). Returns int32/float32
+    numpy arrays (imin, imax, wmin, wmax) each of length ``nout``.
+
+    Handles f == 1 as the identity (the reference aliases the input in
+    that case, riptide/cpp/periodogram.hpp:162-165).
+    """
+    n = downsampled_size(nsamp, f)
+    if n > nout:
+        raise ValueError("nout too small for downsampling factor")
+    imin, imax, wmin, wmax = downsample_indices(nsamp, f)
+    pad = nout - n
+    imin = np.concatenate([imin, np.zeros(pad, np.int64)]).astype(np.int32)
+    imax = np.concatenate([imax, np.zeros(pad, np.int64)]).astype(np.int32)
+    # wint masks the interior prefix-sum term so padding rows are exactly 0
+    # (their boundary weights are already 0).
+    wmin = np.concatenate([wmin, np.zeros(pad)]).astype(np.float32)
+    wmax = np.concatenate([wmax, np.zeros(pad)]).astype(np.float32)
+    wint = np.concatenate([np.ones(n), np.zeros(pad)]).astype(np.float32)
+    return imin, imax, wmin, wmax, wint
+
+
+def downsample_gather(x, cs_hi, cs_lo, imin, imax, wmin, wmax, wint):
+    """
+    Device-side downsample-by-gather. All index/weight operands come from
+    :func:`downsample_plan_padded`; ``cs_hi``/``cs_lo`` from
+    :func:`split_prefix_sums` of the same ``x``.
+    """
+    interior = (jnp.take(cs_hi, imax) - jnp.take(cs_hi, imin + 1)) + (
+        jnp.take(cs_lo, imax) - jnp.take(cs_lo, imin + 1)
+    )
+    out = wmin * jnp.take(x, imin) + wint * interior + wmax * jnp.take(x, imax)
+    return out.astype(jnp.float32)

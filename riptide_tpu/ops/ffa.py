@@ -1,0 +1,143 @@
+"""
+FFA transform on TPU via XLA.
+
+The transform executes as ``L`` vectorised levels over an (R, P) buffer
+(see :mod:`riptide_tpu.ops.plan` for how the reference's recursion —
+riptide/cpp/transforms.hpp:30-50 — is flattened into level tables). Each
+level is a row gather, a per-row circular left-roll of the tail operand
+(the ``fused_rollback_add`` of riptide/cpp/kernels.hpp:19-29, expressed
+as a modular column gather so XLA fuses it with the add), and an add.
+
+Two entry points:
+
+* :func:`ffa2` — user-facing transform of a single (m, p) array.
+* :func:`ffa_levels` — the raw level executor over a padded batch
+  container, used by the periodogram engine and wrapped in scan/vmap.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .plan import ffa_plan
+
+__all__ = ["ffa2", "ffa1", "ffa_levels", "ffafreq", "ffaprd"]
+
+
+def _level_step(buf, tables, p):
+    """
+    One FFA level over a batched container.
+
+    buf : (B, R, P) float32
+    tables : (3, B, R) int32 — stacked (h, t, shift)
+    p : (B,) int32 — per-problem phase bin counts (columns >= p[b] are
+        masked back to zero so padding stays clean)
+    """
+    B, R, P = buf.shape
+    h, t, shift = tables[0], tables[1], tables[2]
+    head = jnp.take_along_axis(buf, h[:, :, None], axis=1)
+    tail = jnp.take_along_axis(buf, t[:, :, None], axis=1)
+    cols = jnp.arange(P, dtype=jnp.int32)[None, None, :]
+    pb = p[:, None, None]
+    idx = (cols + shift[:, :, None]) % pb
+    rolled = jnp.take_along_axis(tail, idx, axis=2)
+    out = head + rolled
+    return jnp.where(cols < pb, out, 0.0)
+
+
+def ffa_levels(buf, h, t, shift, p):
+    """
+    Run all FFA levels over a padded batch container.
+
+    buf : (B, R, P) float32 with rows >= m[b] all zero
+    h, t, shift : (L, B, R) int32 level tables
+    p : (B,) int32
+
+    Returns the transformed (B, R, P) container; valid data is in
+    ``out[b, :m[b], :p[b]]``.
+    """
+    tables = jnp.stack([h, t, shift], axis=1)  # (L, 3, B, R)
+
+    def step(carry, tab):
+        return _level_step(carry, tab, p), None
+
+    out, _ = jax.lax.scan(step, buf, tables)
+    return out
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _ffa2_padded(data, m, p):
+    plan = ffa_plan(m)
+    buf = jnp.zeros((1, m + 1, p), jnp.float32).at[0, :m, :].set(data)
+    out = ffa_levels(
+        buf,
+        jnp.asarray(plan.h)[:, None, :],
+        jnp.asarray(plan.t)[:, None, :],
+        jnp.asarray(plan.shift)[:, None, :],
+        jnp.asarray([p], jnp.int32),
+    )
+    return out[0, :m, :]
+
+
+def ffa2(data):
+    """
+    Compute the FFA transform of a 2D input of shape (m, p): m signal
+    periods by p phase bins. Returns a float32 (m, p) array whose row s is
+    the phase-drift-s folded profile.
+
+    Equivalent of the reference's ``libffa.ffa2`` / ``libcpp.ffa2``
+    (riptide/libffa.py:71-91), executed on the default JAX device.
+    """
+    data = np.asarray(data, dtype=np.float32)
+    if data.ndim != 2:
+        raise ValueError("input data must be two-dimensional")
+    m, p = data.shape
+    if m == 1:
+        return data.copy()
+    return np.asarray(_ffa2_padded(jnp.asarray(data), m, p))
+
+
+def ffa1(data, p):
+    """
+    FFA transform of a 1D time series at base period ``p`` (in samples).
+    The last ``N % p`` samples are ignored. Equivalent of
+    riptide/libffa.py:94-126.
+    """
+    data = np.asarray(data)
+    if data.ndim != 1:
+        raise ValueError("input data must be one-dimensional")
+    if not (isinstance(p, (int, np.integer)) and p > 0):
+        raise ValueError("p must be an integer > 1")
+    if p > data.size:
+        raise ValueError("p must be smaller than the total number of samples")
+    m = data.size // p
+    return ffa2(data[: m * p].reshape(m, p))
+
+
+def ffafreq(N, p, dt=1.0):
+    """
+    Trial frequencies of every folded profile in an FFA output
+    (riptide/libffa.py:129-169): f(s) = (1/p - s/(m-1) * 1/p^2) / dt.
+    """
+    if not (isinstance(N, (int, np.integer)) and N > 0):
+        raise ValueError("N must be a strictly positive integer")
+    if not (isinstance(p, (int, np.integer)) and p > 1):
+        raise ValueError("p must be an integer > 1")
+    if not N >= p:
+        raise ValueError("p must be smaller than (or equal to) N")
+    if not dt > 0:
+        raise ValueError("dt must be strictly positive")
+    f0 = 1.0 / p
+    m = N // p
+    if m == 1:
+        f = np.asarray([f0])
+    else:
+        s = np.arange(m)
+        f = f0 - s / (m - 1.0) * f0**2
+    return f / dt
+
+
+def ffaprd(N, p, dt=1.0):
+    """Trial periods of every folded profile in an FFA output: 1/ffafreq."""
+    return 1.0 / ffafreq(N, p, dt=dt)

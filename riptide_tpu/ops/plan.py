@@ -1,0 +1,163 @@
+"""
+Host-side planning for the TPU FFA transform.
+
+The reference implements the FFA as a recursive divide-in-half of the row
+axis (reference: riptide/cpp/transforms.hpp:30-50). On TPU we execute the
+same computation *iteratively* as ``L = ceil(log2(m))`` data-parallel
+levels over an (R, P) buffer: at each level, every output row is
+
+    out[i] = buf[h[i]] + roll(buf[t[i]], -shift[i])
+
+where (h, t, shift) are integer tables precomputed here on the host. This
+turns the recursion into a static sequence of vectorised gather+roll+add
+stages that XLA/Pallas can tile onto the VPU, with no data-dependent
+control flow inside jit.
+
+Scheduling: a tree node at depth d from the root performs its merge at
+level ``L - d`` (levels are 1-indexed; level 1 runs first). Rows of nodes
+that are already complete (single-row leaves) are carried unchanged
+through intervening levels via identity entries that add a guaranteed
+all-zero row: every plan assumes the working buffer has ``R >= m + 1``
+rows with row ``R - 1`` ("Z") held at zero. Padding rows in [m, R-1) also
+map to Z so they stay zero, which is what lets many differently-sized
+problems share one compiled kernel (see FFABatchPlan).
+"""
+from functools import lru_cache
+
+import numpy as np
+
+from .reference import _merge_mapping
+
+__all__ = ["ffa_plan", "FFAPlan", "batch_plans", "num_levels"]
+
+
+def num_levels(m):
+    """Number of merge levels for an m-row transform: ceil(log2(m)), 0 for m=1."""
+    if m <= 1:
+        return 0
+    return int(np.ceil(np.log2(m)))
+
+
+class FFAPlan:
+    """
+    Level tables for one m-row FFA transform.
+
+    Attributes
+    ----------
+    m : int
+        Number of rows of the transform.
+    levels : int
+        Number of merge levels, ceil(log2(m)).
+    h, t, shift : ndarray of int32, shape (levels, m + 1)
+        Per-level gather tables over an (m + 1)-row buffer whose last row
+        is held at zero. Row i of level l output is
+        ``buf[h[l, i]] + roll(buf[t[l, i]], -shift[l, i])``.
+    """
+
+    def __init__(self, m):
+        m = int(m)
+        L = num_levels(m)
+        R = m + 1
+        Z = m
+        # Identity-carry default: out[i] = buf[i] + buf[Z] (zero row).
+        h = np.tile(np.arange(R, dtype=np.int32), (L, 1))
+        t = np.full((L, R), Z, dtype=np.int32)
+        shift = np.zeros((L, R), dtype=np.int32)
+        # The zero row must reproduce itself at every level.
+        if L:
+            h[:, Z] = Z
+
+        def fill(r0, mn, level):
+            # Merge of the node occupying buffer rows [r0, r0 + mn) happens
+            # at `level` (1-based); its children merge one level earlier.
+            if mn == 1:
+                return
+            mh = mn // 2
+            fill(r0, mh, level - 1)
+            fill(r0 + mh, mn - mh, level - 1)
+            hh, tt, ss = _merge_mapping(mn)
+            l = level - 1
+            h[l, r0 : r0 + mn] = r0 + hh
+            t[l, r0 : r0 + mn] = r0 + mh + tt
+            shift[l, r0 : r0 + mn] = ss
+
+        fill(0, m, L)
+        self.m = m
+        self.levels = L
+        self.h = h
+        self.t = t
+        self.shift = shift
+
+
+@lru_cache(maxsize=512)
+def ffa_plan(m):
+    """Cached :class:`FFAPlan` for an m-row transform."""
+    return FFAPlan(m)
+
+
+class FFABatchPlan:
+    """
+    A batch of B differently-shaped FFA problems padded into one
+    (B, R, P)-shaped container so they execute as a single compiled kernel.
+
+    Problem b folds ``m[b]`` rows of ``p[b]`` phase bins; the container has
+    ``R = max(m) + 1`` rows (last row zero) and ``P >= max(p)`` columns.
+    Shallower plans are padded with identity levels at the end.
+
+    Attributes (all numpy, ready to ship to device):
+    h, t, shift : (L, B, R) int32 level tables
+    m, p : (B,) int32 problem dimensions
+    """
+
+    def __init__(self, ms, ps, R=None, P=None):
+        ms = [int(m) for m in ms]
+        ps = [int(p) for p in ps]
+        if len(ms) != len(ps):
+            raise ValueError("ms and ps must have equal length")
+        B = len(ms)
+        Rmin = max(ms) + 1
+        R = Rmin if R is None else int(R)
+        if R < Rmin:
+            raise ValueError("R must be >= max(m) + 1")
+        P = max(ps) if P is None else int(P)
+        if P < max(ps):
+            raise ValueError("P must be >= max(p)")
+        L = max(num_levels(m) for m in ms)
+        Z = R - 1
+
+        h = np.tile(np.arange(R, dtype=np.int32), (L, B, 1))
+        t = np.full((L, B, R), Z, dtype=np.int32)
+        shift = np.zeros((L, B, R), dtype=np.int32)
+        for b, m in enumerate(ms):
+            plan = ffa_plan(m)
+            lb = plan.levels
+            if lb:
+                h[:lb, b, : m + 1] = plan.h
+                t[:lb, b, : m + 1] = plan.t
+                shift[:lb, b, : m + 1] = plan.shift
+                # plan's zero row is index m; remap to the container's Z.
+                h[:lb, b, : m + 1] = np.where(
+                    h[:lb, b, : m + 1] == m, Z, h[:lb, b, : m + 1]
+                )
+                t[:lb, b, : m + 1] = np.where(
+                    t[:lb, b, : m + 1] == m, Z, t[:lb, b, : m + 1]
+                )
+            # Padding rows [m, R) map to the zero row so they stay zero
+            # (t/shift already default to Z/0; rows finished before level
+            # lb carry via the identity init).
+            h[:, b, m:] = Z
+
+        self.B = B
+        self.R = R
+        self.P = P
+        self.L = L
+        self.h = h
+        self.t = t
+        self.shift = shift
+        self.m = np.asarray(ms, dtype=np.int32)
+        self.p = np.asarray(ps, dtype=np.int32)
+
+
+def batch_plans(ms, ps, R=None, P=None):
+    """Build an :class:`FFABatchPlan` for problems of shapes zip(ms, ps)."""
+    return FFABatchPlan(ms, ps, R=R, P=P)

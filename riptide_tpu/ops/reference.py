@@ -1,0 +1,267 @@
+"""
+Pure-numpy reference ("oracle") implementations of the core FFA numerics.
+
+These functions define the *semantics* that the TPU kernels in
+:mod:`riptide_tpu.ops` must reproduce. They intentionally mirror the
+behaviour of riptide's C++ compute core (see reference files
+``riptide/cpp/{kernels,transforms,downsample,snr,running_median}.hpp``),
+including rounding conventions and edge handling, but are written as
+vectorised numpy code rather than translated loops. They are used:
+
+* as oracles in the test suite (every JAX/Pallas kernel is checked
+  against them),
+* as host-side fallbacks for small problems where device dispatch is
+  not worth it.
+
+Semantics notes
+---------------
+* The FFA merge row mapping uses float32 arithmetic for the ``kh * s + 0.5``
+  index rounding, matching the reference exactly
+  (reference: riptide/cpp/transforms.hpp:17-24).
+* ``circular_prefix_sum`` uses a float64 accumulator
+  (reference: riptide/cpp/kernels.hpp:73-101).
+* ``running_median`` pads both array ends with the edge values
+  (reference: riptide/cpp/running_median.hpp:100-132).
+"""
+from functools import lru_cache
+
+import numpy as np
+
+__all__ = [
+    "ffa_transform",
+    "ffa_shifts",
+    "circular_prefix_sum",
+    "boxcar_snr_1d",
+    "boxcar_snr_2d",
+    "downsampled_size",
+    "downsampled_variance",
+    "downsample",
+    "running_median",
+    "generate_width_trials",
+]
+
+
+# ---------------------------------------------------------------------------
+# FFA transform
+# ---------------------------------------------------------------------------
+
+def _merge_mapping(m):
+    """
+    Row mapping of one FFA merge step for an m-row node split into a head of
+    ``m // 2`` rows and a tail of ``m - m // 2`` rows.
+
+    Returns (h, t, shift) int arrays of length m such that output row ``s``
+    of the merged node is ``head[h[s]] + roll(tail[t[s]], -shift[s])``.
+
+    The index rounding is done in float32, matching the reference C++
+    (riptide/cpp/transforms.hpp:17-24: ``h = kh * s + 0.5f`` with float kh).
+    The total phase shift applied to the tail row works out to ``s - t[s]``.
+    """
+    mh = m // 2
+    mt = m - mh
+    s = np.arange(m, dtype=np.float32)
+    kh = np.float32(mh - 1.0) / np.float32(m - 1.0)
+    kt = np.float32(mt - 1.0) / np.float32(m - 1.0)
+    h = (kh * s + np.float32(0.5)).astype(np.int64)
+    t = (kt * s + np.float32(0.5)).astype(np.int64)
+    shift = np.arange(m, dtype=np.int64) - t
+    return h, t, shift
+
+
+def ffa_transform(data):
+    """
+    FFA transform of a 2D array of shape (m, p): m pulse periods by p phase
+    bins in, m phase-drift trials by p phase bins out. Row s of the output is
+    the sum of the input rows with a linear phase drift of s bins applied
+    across the whole array.
+
+    Matches the recursive divide-in-half structure of the reference
+    (riptide/cpp/transforms.hpp:30-50): head of ``m // 2`` rows and tail of
+    the rest are transformed independently, then merged.
+    """
+    data = np.asarray(data, dtype=np.float32)
+    if data.ndim != 2:
+        raise ValueError("input data must be two-dimensional")
+    m, p = data.shape
+    if m == 1:
+        return data.copy()
+    mh = m // 2
+    head = ffa_transform(data[:mh])
+    tail = ffa_transform(data[mh:])
+    h, t, shift = _merge_mapping(m)
+    cols = (np.arange(p)[None, :] + shift[:, None]) % p
+    rolled = np.take_along_axis(tail[t], cols, axis=1)
+    return head[h] + rolled
+
+
+@lru_cache(maxsize=None)
+def ffa_shifts(m):
+    """
+    Total phase drift (in bins, unreduced) applied to each output row of an
+    m-row FFA transform. Row s of the output has drift s: this function
+    exists to assert that invariant in tests and document the row meaning.
+    """
+    return np.arange(m)
+
+
+# ---------------------------------------------------------------------------
+# Boxcar S/N
+# ---------------------------------------------------------------------------
+
+def circular_prefix_sum(x, nsum):
+    """
+    Prefix sum of ``x`` as if its elements repeated circularly, evaluated for
+    ``nsum`` elements: out[j] = x[0] + x[1] + ... + x[j mod size] (with full
+    wraps adding the array total). Uses a float64 accumulator like the
+    reference (riptide/cpp/kernels.hpp:73-101).
+    """
+    x = np.asarray(x, dtype=np.float32)
+    n = x.size
+    cs = np.cumsum(x, dtype=np.float64)
+    total = cs[-1]
+    j = np.arange(nsum)
+    out = cs[j % n] + (j // n) * total
+    return out.astype(np.float32)
+
+
+def _boxcar_coeffs(nbins, widths):
+    """
+    Height ``h`` and baseline ``b`` of a zero-mean, unit-square-sum boxcar
+    filter of each trial width on an ``nbins``-bin profile
+    (reference: riptide/cpp/snr.hpp:45-49).
+    """
+    w = np.asarray(widths, dtype=np.float64)
+    h = np.sqrt((nbins - w) / (nbins * w))
+    b = w / (nbins - w) * h
+    return h, b
+
+
+def boxcar_snr_1d(x, widths, stdnoise=1.0):
+    """
+    Matched-filter S/N of a single folded profile for each boxcar width
+    trial; phase-rotation invariant (reference: riptide/cpp/snr.hpp:37-55).
+    """
+    x = np.asarray(x, dtype=np.float32)
+    widths = np.asarray(widths)
+    n = x.size
+    if not np.all((widths > 0) & (widths < n)):
+        raise ValueError("trial widths must be all > 0 and < columns")
+    if not stdnoise > 0:
+        raise ValueError("stdnoise must be > 0")
+    wmax = int(widths.max())
+    cpf = circular_prefix_sum(x, n + wmax)
+    total = cpf[n - 1]
+    out = np.empty(widths.size, dtype=np.float32)
+    for iw, w in enumerate(widths):
+        h, b = _boxcar_coeffs(n, w)
+        dmax = (cpf[w : w + n] - cpf[:n]).max()
+        out[iw] = ((h + b) * dmax - b * total) / stdnoise
+    return out
+
+
+def boxcar_snr_2d(x, widths, stdnoise=1.0):
+    """Row-wise :func:`boxcar_snr_1d` over a (rows, bins) array."""
+    x = np.asarray(x, dtype=np.float32)
+    return np.stack([boxcar_snr_1d(row, widths, stdnoise) for row in x])
+
+
+# ---------------------------------------------------------------------------
+# Downsampling by a real-valued factor
+# ---------------------------------------------------------------------------
+
+def downsampled_size(nsamp, f):
+    """Output length after downsampling ``nsamp`` samples by real factor f."""
+    return int(np.floor(nsamp / f))
+
+
+def downsampled_variance(nsamp, f):
+    """
+    Variance of unit-variance Gaussian noise after downsampling by a real
+    factor f; piecewise formula from the reference
+    (riptide/cpp/downsample.hpp:29-38).
+    """
+    k = np.floor(f)
+    r = f - k
+    x = downsampled_size(nsamp, f) * r
+    if x > 1:
+        return f - 1.0 / 3.0
+    return (k - 1.0) ** 2 + 2.0 / 3.0 * x**2 - x + 1.0
+
+
+def downsample_indices(nsamp, f):
+    """
+    Host-side index/weight plan for real-factor downsampling: output sample k
+    sums input samples ``imin[k]..imax[k]`` where the two boundary samples
+    get fractional weights ``wmin[k]`` / ``wmax[k]`` and interior samples
+    weight 1 (reference: riptide/cpp/downsample.hpp:44-82). All arithmetic in
+    float64, indices exact.
+
+    Returns (imin, imax, wmin, wmax) arrays of length ``downsampled_size``.
+    """
+    n = downsampled_size(nsamp, f)
+    k = np.arange(n, dtype=np.float64)
+    start = k * f
+    end = start + f
+    imin = np.floor(start).astype(np.int64)
+    imax = np.minimum(np.floor(end), nsamp - 1.0).astype(np.int64)
+    wmin = (imin + 1.0 - start).astype(np.float64)
+    wmax = (end - imax).astype(np.float64)
+    return imin, imax, wmin, wmax
+
+
+def downsample(data, f):
+    """
+    Downsample a 1D array by a real-valued factor f, 1 < f <= size.
+    Fractional boundary samples are split by linear weights.
+    """
+    data = np.asarray(data, dtype=np.float32)
+    n = data.size
+    if not (f > 1.0 and f <= n):
+        raise ValueError("Downsampling factor must verify: 1 < f <= size")
+    imin, imax, wmin, wmax = downsample_indices(n, f)
+    cs = np.concatenate(([0.0], np.cumsum(data, dtype=np.float64)))
+    # sum of interior samples imin+1 .. imax-1 inclusive
+    interior = cs[imax] - cs[imin + 1]
+    out = wmin * data[imin] + interior + wmax * data[imax]
+    return out.astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Running median
+# ---------------------------------------------------------------------------
+
+def running_median(data, width):
+    """
+    Exact sliding-window median of odd ``width``, with both array ends padded
+    by the edge values (reference: riptide/cpp/running_median.hpp:100-132).
+    """
+    data = np.asarray(data)
+    if data.ndim != 1:
+        raise ValueError("data must be one-dimensional")
+    if not width % 2:
+        raise ValueError("width must be an odd number")
+    if not width < data.size:
+        raise ValueError("width must be < size")
+    half = width // 2
+    padded = np.pad(data, (half, half), mode="edge")
+    windows = np.lib.stride_tricks.sliding_window_view(padded, width)
+    return np.median(windows, axis=-1).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Width trials
+# ---------------------------------------------------------------------------
+
+def generate_width_trials(nbins, ducy_max=0.20, wtsp=1.5):
+    """
+    Geometric-ish boxcar width trial ladder: w(n+1) = max(w + 1, floor(wtsp * w)),
+    capped at ``ducy_max * nbins`` (reference: riptide/ffautils.py:3-10).
+    With wtsp=1.5: 1, 2, 3, 4, 6, 9, 13, 19, ...
+    """
+    widths = []
+    w = 1
+    wmax = int(max(1, ducy_max * nbins))
+    while w <= wmax:
+        widths.append(w)
+        w = int(max(w + 1, wtsp * w))
+    return np.asarray(widths)

@@ -1,0 +1,120 @@
+"""
+Boxcar matched-filter S/N on TPU.
+
+Implements the reference's profile S/N semantics
+(riptide/cpp/snr.hpp:37-65): for each trial width w, slide a zero-mean,
+unit-square-sum boxcar over the circularly-extended profile and take the
+best phase. On TPU the circular prefix sum is a single ``cumsum`` (XLA's
+log-depth scan, which also has *better* rounding than the reference's
+sequential loop), and the per-width phase maximum is an elementwise
+gather + subtract + masked max, all fused by XLA. Widths are vectorised
+by unrolling over the (static, ~10-element) width ladder.
+
+The batched entry point operates on the padded (B, R, P) FFA output
+container of :mod:`riptide_tpu.ops.ffa`, with per-problem bin counts
+``p[b]`` and noise normalisations, so one compiled kernel evaluates every
+phase-bin trial of a periodogram downsampling cycle at once.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .reference import _boxcar_coeffs
+
+__all__ = ["boxcar_coeffs", "snr_batched", "boxcar_snr"]
+
+
+def boxcar_coeffs(nbins, widths):
+    """
+    Height h and (negated) baseline b of a zero-mean unit-square-sum boxcar
+    of each width on an ``nbins``-bin profile (riptide/cpp/snr.hpp:45-49):
+    the filter is +h over w bins and -b elsewhere, h = sqrt((n-w)/(n*w)),
+    b = w/(n-w) * h. Host-side, float64. Single source of truth shared
+    with the numpy oracle.
+    """
+    return _boxcar_coeffs(nbins, widths)
+
+
+def _snr_one_width(cs, total, p, w, P):
+    """
+    max over phase of the w-bin circular boxcar sum, for container cs.
+
+    cs : (..., P) cumulative sum along phase with clean zero padding
+    total : (..., 1) profile totals
+    p : broadcastable int32 per-problem bin count
+    """
+    cols = jnp.arange(P, dtype=jnp.int32)
+    idx = cols + w  # boxcar covering phases [j+1, j+w]
+    wrap = idx >= p
+    idx2 = jnp.clip(jnp.where(wrap, idx - p, idx), 0, P - 1)
+    hi = jnp.take_along_axis(cs, jnp.broadcast_to(idx2, cs.shape[:-1] + (P,)), axis=-1)
+    d = hi + jnp.where(wrap, total, 0.0) - cs
+    d = jnp.where(cols < p, d, -jnp.inf)
+    return jnp.max(d, axis=-1)
+
+
+def snr_batched(tbuf, p, widths, hcoef, bcoef, stdnoise):
+    """
+    S/N of every row of a padded FFA output container, for every width.
+
+    tbuf : (B, R, P) float32, clean-padded (columns >= p[b] and rows >= m[b]
+        are zero)
+    p : (B,) int32 per-problem phase bin counts
+    widths : static tuple of ints (the boxcar width ladder)
+    hcoef, bcoef : (B, NW) float32 per-(problem, width) boxcar coefficients
+    stdnoise : (B,) float32 noise normalisation per problem
+
+    Returns (B, R, NW) float32. Rows >= rows_eval are garbage to be
+    discarded by the caller (they are still computed; pruning happens by
+    slicing on the host, which is cheaper than dynamic shapes on TPU).
+    """
+    B, R, P = tbuf.shape
+    cs = jnp.cumsum(tbuf, axis=-1)
+    total = cs[..., -1:]
+    pb = p[:, None, None]
+    outs = []
+    for iw, w in enumerate(widths):
+        dmax = _snr_one_width(cs, total, pb, int(w), P)  # (B, R)
+        h = hcoef[:, iw][:, None]
+        b = bcoef[:, iw][:, None]
+        outs.append(((h + b) * dmax - b * total[..., 0]) / stdnoise[:, None])
+    return jnp.stack(outs, axis=-1)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _boxcar_snr_2d(data, coeffs, widths):
+    m, p = data.shape
+    cs = jnp.cumsum(data, axis=-1)
+    total = cs[..., -1:]
+    outs = []
+    for iw, w in enumerate(widths):
+        dmax = _snr_one_width(cs, total, p, int(w), p)
+        outs.append((coeffs[iw, 0] + coeffs[iw, 1]) * dmax - coeffs[iw, 1] * total[..., 0])
+    return jnp.stack(outs, axis=-1)
+
+
+def boxcar_snr(data, widths, stdnoise=1.0):
+    """
+    S/N of pulse profile(s) for a range of boxcar width trials; same
+    contract as the reference's ``libffa.boxcar_snr``
+    (riptide/libffa.py:194-225): input of any shape with phase as the last
+    axis, output gains a trailing width-trial axis.
+    """
+    data = np.asarray(data, dtype=np.float32)
+    # Integer widths only, like the reference's uint64 cast
+    # (riptide/libffa.py:219); truncating BEFORE computing coefficients
+    # keeps window and coefficients consistent.
+    widths = np.asarray(widths).astype(np.int64)
+    nbins = data.shape[-1]
+    if not np.all((widths > 0) & (widths < nbins)):
+        raise ValueError("trial widths must be all > 0 and < columns")
+    if not stdnoise > 0:
+        raise ValueError("stdnoise must be > 0")
+    h, b = boxcar_coeffs(nbins, widths)
+    coeffs = np.stack([h, b], axis=-1).astype(np.float32)
+    flat = data.reshape(-1, nbins)
+    snr = _boxcar_snr_2d(jnp.asarray(flat), jnp.asarray(coeffs), tuple(int(w) for w in widths))
+    snr = np.asarray(snr) / np.float32(stdnoise)
+    return snr.reshape(list(data.shape[:-1]) + [widths.size])

@@ -1,0 +1,16 @@
+"""
+Test configuration: force the CPU backend with 8 virtual devices so
+sharding/multi-chip code paths are exercised without TPU hardware, and
+keep everything deterministic.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Persistent compilation cache: kernel shapes repeat across test runs.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/riptide_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")

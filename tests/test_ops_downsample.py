@@ -1,0 +1,102 @@
+"""
+Real-factor downsampling tests: oracle semantics (fractional boundary
+weights), variance formula, and the device gather path incl. hi/lo
+prefix-sum precision on long series.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from riptide_tpu.ops import reference as ref
+from riptide_tpu.ops import (
+    split_prefix_sums,
+    downsample_gather,
+    downsample_plan_padded,
+    downsampled_size,
+    downsampled_variance,
+)
+
+
+def test_oracle_basic():
+    # Factor 2 on integers: plain pairwise sums
+    x = np.arange(8, dtype=np.float32)
+    assert np.allclose(ref.downsample(x, 2.0), [1, 5, 9, 13])
+    # Fractional factor 1.5 on ones: every output sums to 1.5
+    x = np.ones(9, dtype=np.float32)
+    assert np.allclose(ref.downsample(x, 1.5), np.full(6, 1.5))
+
+
+def test_oracle_errors():
+    x = np.ones(16, dtype=np.float32)
+    with pytest.raises(ValueError):
+        ref.downsample(x, 1.0)
+    with pytest.raises(ValueError):
+        ref.downsample(x, 17.0)
+
+
+def test_downsampled_size():
+    assert downsampled_size(100, 4.0) == 25
+    assert downsampled_size(100, 3.7) == 27
+
+
+def test_downsampled_variance():
+    # Fractional factor, long series: x = n*r > 1 -> variance = f - 1/3
+    assert np.isclose(downsampled_variance(10000, 4.5), 4.5 - 1.0 / 3.0)
+    # Integer factor: r = 0 so x = 0 -> (k-1)^2 + 1
+    assert np.isclose(downsampled_variance(10000, 4.0), 9.0 + 1.0)
+    assert np.isclose(downsampled_variance(16, 2.0), 1.0 + 1.0)
+
+
+@pytest.mark.parametrize("f", [1.5, 2.0, 3.7, 16.3])
+def test_device_matches_oracle(f):
+    rng = np.random.RandomState(int(f * 10))
+    x = rng.normal(size=10000).astype(np.float32)
+    n = downsampled_size(x.size, f)
+    hi, lo = split_prefix_sums(x)
+    imin, imax, wmin, wmax, wint = downsample_plan_padded(x.size, f, n + 5)
+    out = np.asarray(
+        downsample_gather(
+            jnp.asarray(x), jnp.asarray(hi), jnp.asarray(lo),
+            jnp.asarray(imin), jnp.asarray(imax),
+            jnp.asarray(wmin), jnp.asarray(wmax), jnp.asarray(wint),
+        )
+    )
+    expected = ref.downsample(x, f)
+    assert np.allclose(out[:n], expected, atol=1e-4)
+    assert np.all(out[n:] == 0.0)
+
+
+def test_device_identity_factor():
+    """f == 1 must reproduce the input exactly through the same path
+    (the reference aliases the buffer, riptide/cpp/periodogram.hpp:162-165)."""
+    x = np.random.RandomState(0).normal(size=1000).astype(np.float32)
+    hi, lo = split_prefix_sums(x)
+    imin, imax, wmin, wmax, wint = downsample_plan_padded(x.size, 1.0, x.size)
+    out = np.asarray(
+        downsample_gather(
+            jnp.asarray(x), jnp.asarray(hi), jnp.asarray(lo),
+            jnp.asarray(imin), jnp.asarray(imax),
+            jnp.asarray(wmin), jnp.asarray(wmax), jnp.asarray(wint),
+        )
+    )
+    assert np.allclose(out, x, atol=1e-5)
+
+
+def test_long_series_precision():
+    """hi/lo split must keep float64-level accuracy on multi-million-sample
+    series where a plain float32 prefix sum would lose catastrophically."""
+    rng = np.random.RandomState(42)
+    x = (rng.normal(size=2**21) + 100.0).astype(np.float32)  # large offset
+    f = 16.3
+    n = downsampled_size(x.size, f)
+    hi, lo = split_prefix_sums(x)
+    imin, imax, wmin, wmax, wint = downsample_plan_padded(x.size, f, n)
+    out = np.asarray(
+        downsample_gather(
+            jnp.asarray(x), jnp.asarray(hi), jnp.asarray(lo),
+            jnp.asarray(imin), jnp.asarray(imax),
+            jnp.asarray(wmin), jnp.asarray(wmax), jnp.asarray(wint),
+        )
+    )
+    expected = ref.downsample(x, f)
+    assert np.allclose(out, expected, rtol=1e-5, atol=2e-3)

@@ -1,0 +1,139 @@
+"""
+FFA transform tests: golden 8x8 values, invariances, oracle parity for
+arbitrary (including non-power-of-2) shapes, and the batched padded
+container path. Mirrors the oracle strategy of the reference suite
+(riptide/tests/test_ffa_base_functions.py).
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from riptide_tpu.ops import reference as ref
+from riptide_tpu.ops import ffa2, ffa1, ffafreq, ffaprd, ffa_levels, batch_plans
+
+# Hand-computable case: a single spike per period, drifting through all
+# phase-shift trials. Invariant under phase rotation and appended zero
+# columns.
+FFA_IN_88 = np.zeros((8, 8), dtype=np.float32)
+FFA_IN_88[:, 7] = 1.0
+
+FFA_OUT_88 = np.array(
+    [
+        [0, 0, 0, 0, 0, 0, 0, 8],
+        [0, 0, 0, 0, 0, 0, 4, 4],
+        [0, 0, 0, 0, 0, 2, 4, 2],
+        [0, 0, 0, 0, 2, 2, 2, 2],
+        [0, 0, 0, 1, 2, 2, 2, 1],
+        [0, 0, 1, 2, 1, 1, 2, 1],
+        [0, 1, 1, 1, 2, 1, 1, 1],
+        [1, 1, 1, 1, 1, 1, 1, 1],
+    ],
+    dtype=np.float32,
+)
+
+
+def test_oracle_golden_88():
+    assert np.allclose(ref.ffa_transform(FFA_IN_88), FFA_OUT_88)
+
+
+def test_jax_golden_88():
+    assert np.allclose(ffa2(FFA_IN_88), FFA_OUT_88)
+
+
+def test_rotation_invariance():
+    for shift in range(8):
+        X = np.roll(FFA_IN_88, shift, axis=1)
+        truth = np.roll(FFA_OUT_88, shift, axis=1)
+        assert np.allclose(ffa2(X), truth)
+        assert np.allclose(ffa1(X.ravel(), 8), truth)
+
+
+def test_zero_column_invariance():
+    for extra in range(1, 8):
+        X = np.hstack([FFA_IN_88, np.zeros((8, extra), dtype=np.float32)])
+        truth = np.hstack([FFA_OUT_88, np.zeros((8, extra), dtype=np.float32)])
+        assert np.allclose(ffa2(X), truth)
+
+
+@pytest.mark.parametrize("m", [2, 3, 5, 7, 8, 12, 13, 16, 33, 100, 127, 128, 255])
+@pytest.mark.parametrize("p", [4, 16, 37, 260])
+def test_jax_vs_oracle(m, p):
+    rng = np.random.RandomState(m * 1000 + p)
+    x = rng.normal(size=(m, p)).astype(np.float32)
+    expected = ref.ffa_transform(x)
+    got = ffa2(x)
+    assert np.allclose(got, expected, atol=1e-4), np.abs(got - expected).max()
+
+
+def test_m1_identity():
+    x = np.random.RandomState(0).normal(size=(1, 16)).astype(np.float32)
+    assert np.array_equal(ffa2(x), x)
+
+
+def test_errors():
+    with pytest.raises(ValueError):
+        ffa2(np.zeros(4))
+    with pytest.raises(ValueError):
+        ffa1(np.zeros((4, 4)), 4)
+    with pytest.raises(ValueError):
+        ffa1(np.zeros(10), 11)
+    with pytest.raises(ValueError):
+        ffa1(np.zeros(10), 4.0)
+
+
+def test_batched_padded_container():
+    """Several differently-shaped problems in one padded (B, R, P) kernel
+    call must each match the single-problem oracle, and padding must stay
+    exactly zero."""
+    shapes = [(13, 20), (8, 24), (21, 17), (1, 10), (2, 24)]
+    ms = [m for m, _ in shapes]
+    ps = [p for _, p in shapes]
+    plan = batch_plans(ms, ps, R=max(ms) + 3, P=32)
+    rng = np.random.RandomState(7)
+    xs = [rng.normal(size=s).astype(np.float32) for s in shapes]
+
+    buf = np.zeros((plan.B, plan.R, plan.P), dtype=np.float32)
+    for b, x in enumerate(xs):
+        buf[b, : x.shape[0], : x.shape[1]] = x
+
+    out = np.asarray(
+        ffa_levels(
+            jnp.asarray(buf),
+            jnp.asarray(plan.h),
+            jnp.asarray(plan.t),
+            jnp.asarray(plan.shift),
+            jnp.asarray(plan.p),
+        )
+    )
+    for b, x in enumerate(xs):
+        m, p = x.shape
+        expected = ref.ffa_transform(x)
+        assert np.allclose(out[b, :m, :p], expected, atol=1e-4)
+        # padding stays clean
+        assert np.all(out[b, m:, :] == 0)
+        assert np.all(out[b, :, p:] == 0)
+
+
+def test_ffafreq_matches_closed_form():
+    N, p, dt = 104, 10, 0.5
+    f = ffafreq(N, p, dt=dt)
+    m = N // p
+    assert f.size == m
+    # first trial: exactly 1/(p*dt); last trial: 1/(p+1 samples)
+    assert np.isclose(f[0], 1.0 / (p * dt))
+    assert np.isclose(f[-1], (1.0 / p - 1.0 / p**2) / dt)
+    prd = ffaprd(N, p, dt=dt)
+    assert np.allclose(prd, 1.0 / f)
+    # m == 1 special case
+    assert np.allclose(ffafreq(10, 10, dt=2.0), [1.0 / 20.0])
+
+
+def test_ffafreq_errors():
+    with pytest.raises(ValueError):
+        ffafreq(0, 4)
+    with pytest.raises(ValueError):
+        ffafreq(16, 1)
+    with pytest.raises(ValueError):
+        ffafreq(8, 9)
+    with pytest.raises(ValueError):
+        ffafreq(8, 4, dt=0.0)

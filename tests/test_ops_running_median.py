@@ -1,0 +1,64 @@
+"""
+Running median tests: naive-oracle parity with edge padding, fast
+(scrunched) path consistency. Mirrors riptide/tests/test_running_median.py.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from riptide_tpu.ops import reference as ref
+from riptide_tpu.ops import running_median_jax, scrunch_jax, fast_running_median_jax
+
+
+def naive_running_median(data, w):
+    h = w // 2
+    padded = np.pad(data, (h, h), mode="edge")
+    return np.asarray([np.median(padded[i : i + w]) for i in range(data.size)])
+
+
+@pytest.mark.parametrize("w", [1, 3, 5, 7, 11, 25, 37])
+def test_oracle_vs_naive(w):
+    x = np.random.RandomState(0).normal(size=100).astype(np.float32)
+    assert np.array_equal(ref.running_median(x, w), naive_running_median(x, w).astype("f"))
+
+
+@pytest.mark.parametrize("w", [1, 3, 5, 7, 11, 25, 37])
+def test_jax_vs_oracle(w):
+    x = np.random.RandomState(1).normal(size=100).astype(np.float32)
+    got = np.asarray(running_median_jax(jnp.asarray(x), w))
+    assert np.allclose(got, ref.running_median(x, w))
+
+
+def test_oracle_errors():
+    data = np.arange(10, dtype=np.float32)
+    with pytest.raises(ValueError):
+        ref.running_median(data, 2)
+    with pytest.raises(ValueError):
+        ref.running_median(data, 11)
+    with pytest.raises(ValueError):
+        ref.running_median(np.zeros((4, 8)), 3)
+
+
+def test_scrunch():
+    x = np.arange(10, dtype=np.float32)
+    got = np.asarray(scrunch_jax(jnp.asarray(x), 3))
+    assert np.allclose(got, [1.0, 4.0, 7.0])
+
+
+def test_fast_path_no_scrunch_equals_exact():
+    """When width <= min_points the fast path must be the exact median."""
+    x = np.random.RandomState(2).normal(size=500).astype(np.float32)
+    got = np.asarray(fast_running_median_jax(jnp.asarray(x), 51, 101))
+    assert np.allclose(got, ref.running_median(x, 51))
+
+
+def test_fast_path_scrunched_tracks_trend():
+    """Scrunched approximate path must track a slow baseline closely."""
+    n = 20000
+    t = np.arange(n, dtype=np.float32)
+    baseline = np.sin(2 * np.pi * t / n).astype(np.float32) * 10
+    x = baseline + np.random.RandomState(3).normal(size=n).astype(np.float32)
+    got = np.asarray(fast_running_median_jax(jnp.asarray(x), 2001, 101))
+    # middle section (away from edges) must track the baseline
+    mid = slice(2000, n - 2000)
+    assert np.abs(got[mid] - baseline[mid]).max() < 0.5

@@ -1,0 +1,93 @@
+"""
+Boxcar S/N tests: analytic values, phase-rotation invariance, output
+dims, oracle parity, and the batched padded-container path. Mirrors
+riptide/tests/test_snr.py.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from riptide_tpu.ops import reference as ref
+from riptide_tpu.ops import boxcar_snr, boxcar_coeffs, snr_batched
+
+
+def test_errors():
+    data = np.zeros(32, dtype=np.float32)
+    with pytest.raises(ValueError):
+        boxcar_snr(data, [0, 1])
+    with pytest.raises(ValueError):
+        boxcar_snr(data, [1, 32])
+    with pytest.raises(ValueError):
+        boxcar_snr(data, [1, 2], stdnoise=-42.0)
+
+
+def test_output_dims():
+    widths = [1, 2, 3, 5]
+    assert boxcar_snr(np.zeros(32, "f"), widths).shape == (4,)
+    assert boxcar_snr(np.zeros((4, 32), "f"), widths).shape == (4, 4)
+    assert boxcar_snr(np.zeros((3, 4, 32), "f"), widths).shape == (3, 4, 4)
+
+
+def test_phase_rotation_invariance():
+    rng = np.random.RandomState(3)
+    data = rng.normal(size=(4, 32)).astype(np.float32)
+    widths = [1, 2, 5, 11, 18, 31]
+    snr_ref = boxcar_snr(data, widths)
+    for shift in range(1, 33):
+        snr = boxcar_snr(np.roll(data, shift, axis=-1), widths)
+        assert np.allclose(snr, snr_ref, atol=1e-4)
+
+
+def test_analytic_values():
+    """A unit boxcar pulse of true width w in zeros: best trial must be w,
+    with S/N exactly w * h(w) (riptide/tests/test_snr.py:62-78)."""
+    n = 64
+    widths = np.arange(1, n)
+    for w in range(1, n):
+        data = np.zeros(n, dtype=np.float32)
+        data[:w] = 1.0
+        snr = boxcar_snr(data, widths)
+        assert snr.argmax() == w - 1
+        h = np.sqrt((n - w) / (n * w))
+        assert np.allclose(snr.max(), w * h, rtol=1e-5)
+
+
+def test_vs_oracle():
+    rng = np.random.RandomState(11)
+    data = rng.normal(size=(20, 260)).astype(np.float32)
+    widths = ref.generate_width_trials(240)
+    got = boxcar_snr(data, widths, stdnoise=2.5)
+    expected = ref.boxcar_snr_2d(data, widths, stdnoise=2.5)
+    assert np.allclose(got, expected, atol=1e-4)
+
+
+def test_snr_batched_padded():
+    """Padded batch: each problem must match the single-profile oracle."""
+    rng = np.random.RandomState(5)
+    widths = (1, 2, 3, 4, 6, 9)
+    shapes = [(7, 50), (5, 64), (9, 47)]
+    B, R, P = len(shapes), 10, 64
+    stds = np.asarray([1.0, 2.0, 0.5], np.float32)
+    buf = np.zeros((B, R, P), np.float32)
+    for b, (m, p) in enumerate(shapes):
+        buf[b, :m, :p] = rng.normal(size=(m, p))
+
+    hcoef = np.zeros((B, len(widths)), np.float32)
+    bcoef = np.zeros((B, len(widths)), np.float32)
+    for b, (_, p) in enumerate(shapes):
+        h, bb = boxcar_coeffs(p, widths)
+        hcoef[b], bcoef[b] = h, bb
+
+    out = np.asarray(
+        snr_batched(
+            jnp.asarray(buf),
+            jnp.asarray([p for _, p in shapes], jnp.int32),
+            widths,
+            jnp.asarray(hcoef),
+            jnp.asarray(bcoef),
+            jnp.asarray(stds),
+        )
+    )
+    for b, (m, p) in enumerate(shapes):
+        expected = ref.boxcar_snr_2d(buf[b, :m, :p], np.asarray(widths), stds[b])
+        assert np.allclose(out[b, :m], expected, atol=1e-4)
