@@ -1,0 +1,50 @@
+"""
+riptide_tpu: a TPU-native Fast Folding Algorithm (FFA) pulsar search
+framework.
+
+Searches one or many dedispersed time series for periodic signals,
+producing periodograms (S/N versus trial period and pulse width), peak
+lists, clusters, harmonic flags and candidate files. The compute core —
+downsampling cascade, FFA fold tree and boxcar matched filtering — runs
+as planned XLA/Pallas programs on TPU, batched over DM trials and
+shardable across a device mesh; data handling, clustering and candidate
+building stay on the host.
+
+Same capability surface as the reference ``riptide`` package, rebuilt
+TPU-first.
+"""
+from .metadata import Metadata
+from .time_series import TimeSeries
+from .periodogram import Periodogram
+from .libffa import (
+    ffa1,
+    ffa2,
+    ffafreq,
+    ffaprd,
+    boxcar_snr,
+    downsample,
+    generate_signal,
+    generate_width_trials,
+)
+from .running_medians import running_median, fast_running_median
+from .search import ffa_search, periodogram_plan, run_periodogram, run_periodogram_batch
+from .serialization import save_json, load_json
+from .peak_detection import find_peaks, Peak
+from .candidate import Candidate
+
+__version__ = "0.1.0"
+
+
+def test():
+    """Run the test suite in-process (requires pytest and a repository
+    checkout — the suite lives in <repo>/tests next to the package)."""
+    import os
+    import pytest
+
+    path = os.path.join(os.path.dirname(os.path.dirname(__file__)), "tests")
+    if not os.path.isdir(path):
+        raise RuntimeError(
+            "riptide_tpu.test() requires a repository checkout; "
+            f"no test directory found at {path}"
+        )
+    return pytest.main(["-v", path])

@@ -1,0 +1,137 @@
+"""
+rseek: FFA-search a single dedispersed time series and print a table of
+significant peaks. Same CLI surface and defaults as the reference's
+``rseek`` console script (riptide/apps/rseek.py); the search itself runs
+on the default JAX device (TPU when available).
+"""
+import argparse
+import logging
+
+import numpy as np
+
+log = logging.getLogger("riptide_tpu.rseek")
+
+
+def _help_formatter(prog):
+    return argparse.ArgumentDefaultsHelpFormatter(prog, max_help_position=16)
+
+
+def get_parser():
+    from riptide_tpu import __version__
+
+    parser = argparse.ArgumentParser(
+        formatter_class=_help_formatter,
+        description=(
+            "FFA search a single time series and print a table of parameters "
+            "of all significant peaks found. Peaks found with nearly identical "
+            "periods at different trial pulse widths are grouped, but no "
+            "harmonic filtering is performed."
+        ),
+    )
+    parser.add_argument(
+        "-f", "--format", type=str, choices=("presto", "sigproc"), required=True,
+        help="Input TimeSeries format",
+    )
+    parser.add_argument("--Pmin", type=float, default=1.0, help="Minimum trial period in seconds")
+    parser.add_argument("--Pmax", type=float, default=10.0, help="Maximum trial period in seconds")
+    parser.add_argument("--bmin", type=int, default=240, help="Minimum number of phase bins used in the search")
+    parser.add_argument("--bmax", type=int, default=260, help="Maximum number of phase bins used in the search")
+    parser.add_argument("--smin", type=float, default=7.0, help="Only report peaks above this minimum S/N")
+    parser.add_argument(
+        "--wtsp", type=float, default=1.5,
+        help="Geometric factor between consecutive trial pulse widths",
+    )
+    parser.add_argument(
+        "--rmed_width", type=float, default=4.0,
+        help="Width (in seconds) of the running median filter to subtract "
+        "from the input data before processing",
+    )
+    parser.add_argument(
+        "--rmed_minpts", type=float, default=101,
+        help="Minimum number of scrunched samples that must fit in the "
+        "running median window (lower is faster but less accurate)",
+    )
+    parser.add_argument(
+        "--clrad", type=float, default=0.2,
+        help="Frequency clustering radius in units of 1/Tobs. Peaks with "
+        "similar freqs are grouped together, and only the brightest one of "
+        "the group is printed",
+    )
+    parser.add_argument("fname", type=str, help="Input file name")
+    parser.add_argument("--version", action="version", version=__version__)
+    return parser
+
+
+def run_program(args):
+    """
+    Run rseek; returns a pandas DataFrame of detected peak parameters
+    (columns period/freq/width/ducy/dm/snr), or None if nothing
+    significant was found.
+    """
+    import pandas
+
+    from riptide_tpu import TimeSeries, ffa_search
+    from riptide_tpu.clustering import cluster1d
+    from riptide_tpu.peak_detection import find_peaks
+
+    logging.basicConfig(
+        level="DEBUG",
+        format="%(asctime)s %(filename)18s:%(lineno)-4s %(levelname)-8s %(message)s",
+    )
+
+    loaders = {"sigproc": TimeSeries.from_sigproc, "presto": TimeSeries.from_presto_inf}
+    ts = loaders[args.format](args.fname)
+
+    log.debug(
+        f"Searching period range [{args.Pmin}, {args.Pmax}] seconds "
+        f"with {args.bmin} to {args.bmax} phase bins"
+    )
+    _, pgram = ffa_search(
+        ts,
+        period_min=args.Pmin,
+        period_max=args.Pmax,
+        bins_min=args.bmin,
+        bins_max=args.bmax,
+        rmed_width=args.rmed_width,
+        rmed_minpts=args.rmed_minpts,
+        wtsp=args.wtsp,
+        fpmin=1,
+        ducy_max=0.3,
+    )
+    peaks, _ = find_peaks(pgram, smin=args.smin, clrad=args.clrad)
+    if not peaks:
+        print(f"No peaks found above S/N = {args.smin:.2f}")
+        return None
+
+    # Group peaks across width trials: keep the brightest per frequency
+    # cluster.
+    freqs = np.asarray([p.freq for p in peaks])
+    clusters = cluster1d(freqs, r=args.clrad / ts.length)
+    peaks = [max((peaks[i] for i in idx), key=lambda p: p.snr) for idx in clusters]
+    peaks = sorted(peaks, key=lambda p: p.snr, reverse=True)
+
+    df = pandas.DataFrame(peaks).drop(columns=["iw", "ip"])
+    formatters = {
+        "period": "  {:.9f}".format,
+        "freq": "  {:.9f}".format,
+        "ducy": lambda x: "  {:#.2f}%".format(100 * x),
+        "dm": "  {:.2f}".format,
+        "snr": "  {:.1f}".format,
+    }
+    print(
+        df.to_string(
+            columns=["period", "freq", "width", "ducy", "dm", "snr"],
+            formatters=formatters,
+            index=False,
+        )
+    )
+    return df
+
+
+def main():
+    """Console entry point for 'rseek'."""
+    run_program(get_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

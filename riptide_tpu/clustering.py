@@ -1,0 +1,28 @@
+"""
+1-D friends-of-friends clustering (reference: riptide/clustering.py).
+"""
+import numpy as np
+
+__all__ = ["cluster1d"]
+
+
+def cluster1d(x, r, already_sorted=False):
+    """
+    Cluster 1-D points: two points share a cluster if they lie within
+    distance ``r`` of each other (chained). Returns a list of index arrays
+    into ``x``.
+    """
+    x = np.asarray(x)
+    if not len(x):
+        return []
+    if not already_sorted:
+        indices = x.argsort()
+        diff = np.diff(x[indices])
+    else:
+        indices = np.arange(len(x))
+        diff = np.diff(x)
+    ibreaks = np.where(np.abs(diff) > r)[0]
+    if not len(ibreaks):
+        return [indices]
+    ibounds = np.concatenate(([0], ibreaks + 1, [len(x)]))
+    return [indices[start:end] for start, end in zip(ibounds[:-1], ibounds[1:])]

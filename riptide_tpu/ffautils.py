@@ -1,0 +1,4 @@
+"""Width-trial ladder generation (reference: riptide/ffautils.py)."""
+from .ops.reference import generate_width_trials
+
+__all__ = ["generate_width_trials"]

@@ -1,0 +1,70 @@
+"""
+Time series folding (candidate sub-integration production).
+Reference semantics: riptide/folding.py.
+"""
+import numpy as np
+
+from .libffa import downsample
+
+__all__ = ["fold", "downsample_vertical"]
+
+
+def downsample_vertical(X, factor):
+    """Downsample each column of a 2D array by a real factor (used to
+    reduce sub-integration counts)."""
+    m, _ = X.shape
+    if not factor > 1:
+        raise ValueError("factor must be > 1")
+    if not factor < m:
+        raise ValueError("factor must be strictly smaller than the number of input lines")
+    out = np.asarray([downsample(col, factor) for col in np.ascontiguousarray(X.T)])
+    return np.ascontiguousarray(out.T)
+
+
+def fold(ts, period, bins, subints=None):
+    """
+    Fold a TimeSeries at the given period.
+
+    Parameters
+    ----------
+    ts : TimeSeries
+    period : float
+        Period in seconds.
+    bins : int
+        Number of phase bins; bin width must exceed the sampling time.
+    subints : int or None, optional
+        Number of sub-integrations; None keeps one row per full period.
+
+    Returns
+    -------
+    ndarray — (subints, bins) if subints > 1, else 1D with ``bins``
+    elements. Scaled by (m * factor)^-1/2 so white noise keeps unit
+    variance.
+    """
+    if period > ts.length:
+        raise ValueError("Period exceeds data length")
+    tbin = period / bins
+    if not tbin > ts.tsamp:
+        raise ValueError("Bin width is shorter than sampling time")
+    if subints is not None:
+        subints = int(subints)
+        if not subints >= 1:
+            raise ValueError("subints must be >= 1 or None")
+        full_periods = ts.length / period
+        if subints > full_periods:
+            raise ValueError(
+                f"subints ({subints}) exceeds the number of signal periods "
+                f"that fit in the data ({full_periods})"
+            )
+
+    factor = tbin / ts.tsamp
+    tsdown = ts.downsample(factor)
+    m = tsdown.nsamp // bins
+    folded = tsdown.data[: m * bins].reshape(m, bins)
+    folded = folded * (m * factor) ** -0.5
+
+    if subints == 1 or m == 1:
+        return folded.sum(axis=0)
+    if subints is None or subints == m:
+        return folded
+    return downsample_vertical(folded, m / subints)

@@ -109,7 +109,7 @@ class FFABatchPlan:
     m, p : (B,) int32 problem dimensions
     """
 
-    def __init__(self, ms, ps, R=None, P=None):
+    def __init__(self, ms, ps, R=None, P=None, L=None):
         ms = [int(m) for m in ms]
         ps = [int(p) for p in ps]
         if len(ms) != len(ps):
@@ -122,7 +122,12 @@ class FFABatchPlan:
         P = max(ps) if P is None else int(P)
         if P < max(ps):
             raise ValueError("P must be >= max(p)")
-        L = max(num_levels(m) for m in ms)
+        Lmin = max(num_levels(m) for m in ms)
+        # Extra levels beyond a problem's own depth are identity carries;
+        # padding L lets differently-deep batches share compiled kernels.
+        L = Lmin if L is None else int(L)
+        if L < Lmin:
+            raise ValueError("L must be >= the deepest problem's level count")
         Z = R - 1
 
         h = np.tile(np.arange(R, dtype=np.int32), (L, B, 1))

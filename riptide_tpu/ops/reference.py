@@ -38,6 +38,7 @@ __all__ = [
     "downsample",
     "running_median",
     "generate_width_trials",
+    "periodogram_ref",
 ]
 
 
@@ -246,6 +247,58 @@ def running_median(data, width):
     padded = np.pad(data, (half, half), mode="edge")
     windows = np.lib.stride_tricks.sliding_window_view(padded, width)
     return np.median(windows, axis=-1).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Full periodogram (slow oracle for the device engine)
+# ---------------------------------------------------------------------------
+
+def periodogram_ref(data, tsamp, widths, period_min, period_max, bins_min, bins_max):
+    """
+    Slow numpy periodogram with the exact semantics of the reference's
+    search loop (riptide/cpp/periodogram.hpp:117-201): geometric
+    downsampling cascade x phase-bin loop x (FFA transform + boxcar S/N),
+    with ceilshift row pruning and float64 trial periods. Oracle for
+    :mod:`riptide_tpu.search.engine`.
+
+    Returns (periods float64, foldbins uint32, snrs float32 (len, NW)).
+    """
+    data = np.asarray(data, dtype=np.float32)
+    size = data.size
+    widths = np.asarray(widths)
+    ds_ini = period_min / (tsamp * bins_min)
+    ds_geo = (bins_max + 1.0) / bins_min
+    num_ds = int(np.ceil(np.log(period_max / period_min) / np.log(ds_geo)))
+
+    periods, foldbins, snrs = [], [], []
+    for ids in range(num_ds):
+        f = ds_ini * ds_geo**ids
+        tau = f * tsamp
+        pms = period_max / tau
+        n = downsampled_size(size, f)
+        x = data if f == 1 else downsample(data, f)
+        x = x[:n]
+        for bins in range(bins_min, min(bins_max, n, int(pms)) + 1):
+            rows = n // bins
+            stdnoise = np.sqrt(rows * downsampled_variance(size, f))
+            period_ceil = min(pms, bins + 1.0)
+            cshift = int(np.ceil(bins * (rows - 1.0) * (1.0 - bins / period_ceil)))
+            rows_eval = min(rows, max(cshift, 0))
+            if rows_eval <= 0:
+                continue
+            tf = ffa_transform(x[: rows * bins].reshape(rows, bins))
+            snrs.append(boxcar_snr_2d(tf[:rows_eval], widths, stdnoise))
+            s = np.arange(rows_eval, dtype=np.float64)
+            periods.append(tau * bins * bins / (bins - s / (rows - 1.0)))
+            foldbins.append(np.full(rows_eval, bins, np.uint32))
+    nw = widths.size
+    if not periods:
+        return np.empty(0), np.empty(0, np.uint32), np.empty((0, nw), np.float32)
+    return (
+        np.concatenate(periods),
+        np.concatenate(foldbins),
+        np.concatenate(snrs).astype(np.float32),
+    )
 
 
 # ---------------------------------------------------------------------------

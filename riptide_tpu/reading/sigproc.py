@@ -1,0 +1,146 @@
+"""
+SIGPROC dedispersed time series reading.
+
+Binary header of length-prefixed keys between HEADER_START/HEADER_END;
+int keys are 32-bit, float keys are C doubles, bool keys are unsigned
+chars, strings are length-prefixed (reference semantics:
+riptide/reading/sigproc.py).
+"""
+import os
+import struct
+
+from ..utils.coords import SkyCoord, parse_sigproc_float_coord
+
+__all__ = ["SigprocHeader", "read_sigproc_header", "parse_float_coord"]
+
+SIGPROC_KEYS = {
+    "filename": str,
+    "telescope_id": int,
+    "telescope": str,
+    "machine_id": int,
+    "data_type": int,
+    "rawdatafile": str,
+    "source_name": str,
+    "barycentric": int,
+    "pulsarcentric": int,
+    "az_start": float,
+    "za_start": float,
+    "src_raj": float,
+    "src_dej": float,
+    "tstart": float,
+    "tsamp": float,
+    "nbits": int,
+    "nsamples": int,
+    "fch1": float,
+    "foff": float,
+    "fchannel": float,
+    "nchans": int,
+    "nifs": int,
+    "refdm": float,
+    "flux": float,
+    "period": float,
+    "nbeams": int,
+    "ibeam": int,
+    "hdrlen": int,
+    "pb": float,
+    "ecc": float,
+    "asini": float,
+    "orig_hdrlen": int,
+    "new_hdrlen": int,
+    "sampsize": int,
+    "bandwidth": float,
+    "fbottom": float,
+    "ftop": float,
+    "obs_date": str,
+    "obs_time": str,
+    "accel": float,
+    "signed": bool,
+}
+
+HEADER_START = "HEADER_START"
+HEADER_END = "HEADER_END"
+
+parse_float_coord = parse_sigproc_float_coord
+
+
+def _read_str(fobj):
+    (size,) = struct.unpack("i", fobj.read(4))
+    return fobj.read(size).decode()
+
+
+def read_sigproc_header(fobj, extra_keys=None):
+    """
+    Read a SIGPROC header from an open binary file. Unknown keys raise
+    KeyError unless their type is supplied via ``extra_keys``
+    (riptide/reading/sigproc.py:86-89). Returns (attrs dict, header size
+    in bytes).
+    """
+    keydb = dict(SIGPROC_KEYS)
+    if extra_keys:
+        keydb.update(extra_keys)
+
+    fobj.seek(0)
+    flag = _read_str(fobj)
+    if flag != HEADER_START:
+        raise ValueError(
+            f"File starts with {flag!r} flag instead of the expected {HEADER_START!r}"
+        )
+
+    attrs = {}
+    while True:
+        key = _read_str(fobj)
+        if key == HEADER_END:
+            break
+        atype = keydb.get(key)
+        if atype is None:
+            raise KeyError(
+                f"Type of SIGPROC header attribute {key!r} is unknown, please specify it"
+            )
+        if atype == str:
+            attrs[key] = _read_str(fobj)
+        elif atype == int:
+            (attrs[key],) = struct.unpack("i", fobj.read(4))
+        elif atype == float:
+            (attrs[key],) = struct.unpack("d", fobj.read(8))
+        elif atype == bool:
+            (v,) = struct.unpack("B", fobj.read(1))
+            attrs[key] = bool(v)
+        else:
+            raise ValueError(f"Key {key!r} has unsupported type {atype!r}")
+    return attrs, fobj.tell()
+
+
+class SigprocHeader(dict):
+    """Parsed SIGPROC header with file-derived size properties."""
+
+    def __init__(self, fname, extra_keys=None):
+        self._fname = os.path.abspath(fname)
+        with open(self._fname, "rb") as fobj:
+            attrs, self._bytesize = read_sigproc_header(fobj, extra_keys)
+        super().__init__(attrs)
+
+    @property
+    def fname(self):
+        return self._fname
+
+    @property
+    def bytesize(self):
+        """Header size in bytes (data starts at this offset)."""
+        return self._bytesize
+
+    @property
+    def bytes_per_sample(self):
+        return self["nchans"] * self["nbits"] // 8
+
+    @property
+    def nsamp(self):
+        """Sample count inferred from the file size."""
+        return (os.path.getsize(self.fname) - self.bytesize) // self.bytes_per_sample
+
+    @property
+    def tobs(self):
+        return self.nsamp * self["tsamp"]
+
+    @property
+    def skycoord(self):
+        return SkyCoord.from_sigproc(self["src_raj"], self["src_dej"])

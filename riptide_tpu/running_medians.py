@@ -1,0 +1,49 @@
+"""
+Running-median utilities (detrending support). Public API mirrors the
+reference's riptide/running_medians.py; the compute runs on the default
+JAX device via :mod:`riptide_tpu.ops.running_median`.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .ops.running_median import running_median_jax, fast_running_median_jax
+
+__all__ = ["running_median", "scrunch", "fast_running_median"]
+
+
+def running_median(x, width_samples):
+    """
+    Exact running median with window ``width_samples`` (odd, smaller than
+    the data length); both array ends are implicitly padded with the edge
+    values.
+    """
+    x = np.asarray(x)
+    if x.ndim != 1:
+        raise ValueError("data must be one-dimensional")
+    if not width_samples % 2:
+        raise ValueError("width must be an odd number")
+    if not width_samples < x.size:
+        raise ValueError("width must be < size")
+    return np.asarray(running_median_jax(jnp.asarray(np.ascontiguousarray(x)), int(width_samples)))
+
+
+def scrunch(data, factor):
+    """Reduce resolution by averaging consecutive elements."""
+    factor = int(factor)
+    n = (data.size // factor) * factor
+    return data[:n].reshape(-1, factor).mean(axis=1)
+
+
+def fast_running_median(data, width_samples, min_points=101):
+    """
+    Approximate running median for large windows: scrunch so the window is
+    ~min_points samples, exact median at low resolution, linear
+    interpolation back (reference: riptide/running_medians.py:49-83).
+    min_points must be odd.
+    """
+    if not (min_points % 2):
+        raise ValueError("min_points must be an odd number")
+    data = np.asarray(data)
+    return np.asarray(
+        fast_running_median_jax(jnp.asarray(data), int(width_samples), int(min_points))
+    )

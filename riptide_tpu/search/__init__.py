@@ -1,0 +1,81 @@
+"""
+FFA search driver: plan on host, execute on device.
+"""
+import numpy as np
+
+from ..ffautils import generate_width_trials
+from ..periodogram import Periodogram
+from ..timing import timing
+from .engine import run_periodogram, run_periodogram_batch
+from .plan import PeriodogramPlan, periodogram_plan
+
+__all__ = [
+    "ffa_search",
+    "periodogram_plan",
+    "PeriodogramPlan",
+    "run_periodogram",
+    "run_periodogram_batch",
+]
+
+
+@timing
+def ffa_search(
+    tseries,
+    period_min=1.0,
+    period_max=30.0,
+    fpmin=8,
+    bins_min=240,
+    bins_max=260,
+    ducy_max=0.20,
+    wtsp=1.5,
+    deredden=True,
+    rmed_width=4.0,
+    rmed_minpts=101,
+    already_normalised=False,
+):
+    """
+    Run an FFA search of a single TimeSeries, producing its periodogram.
+
+    Same contract and defaults as the reference's ``ffa_search``
+    (riptide/search.py:11-82): de-redden then normalise (in that order),
+    generate the boxcar width ladder from ``bins_min``, then search every
+    trial period in [period_min, min(period_max, length / fpmin)].
+
+    Parameters mirror the reference; see in particular:
+    - fpmin: documented in the reference as capping period_max at
+      DATA_LENGTH / fpmin, but its implementation never applies the cap
+      (riptide/search.py:11-80 accepts and ignores it); we reproduce that
+      behaviour exactly for output parity. The effective period ceiling
+      comes from the cascade itself (trials stop when fewer than bins_min
+      samples remain per fold).
+    - bins_min/bins_max: phase bin range of the folds; the data are
+      iteratively downsampled so bins stay within it as the trial period
+      grows.
+    - ducy_max, wtsp: boxcar width ladder parameters.
+    - rmed_width, rmed_minpts: running median de-reddening parameters.
+
+    Returns
+    -------
+    ts : TimeSeries
+        The de-reddened, normalised series that was actually searched.
+    pgram : Periodogram
+    """
+    # Prepare data: deredden then normalise IN THAT ORDER
+    if deredden:
+        tseries = tseries.deredden(rmed_width, minpts=rmed_minpts)
+    if not already_normalised:
+        tseries = tseries.normalise()
+
+    widths = generate_width_trials(bins_min, ducy_max=ducy_max, wtsp=wtsp)
+    plan = periodogram_plan(
+        tseries.nsamp,
+        tseries.tsamp,
+        tuple(int(w) for w in widths),
+        float(period_min),
+        float(period_max),
+        int(bins_min),
+        int(bins_max),
+    )
+    periods, foldbins, snrs = run_periodogram(plan, tseries.data)
+    pgram = Periodogram(widths, periods, foldbins, snrs, metadata=tseries.metadata)
+    return tseries, pgram

@@ -1,0 +1,192 @@
+"""
+Device execution of a periodogram plan.
+
+Each cascade cycle runs as ONE jitted program over a padded
+(B, R, P) container (B = number of phase-bin trials of the cycle):
+
+    downsample-by-gather -> pack rows -> FFA levels (scan) -> boxcar S/N
+
+The program is shape-polymorphic in everything data-like (level tables,
+downsample plans, coefficients are traced operands), so XLA compiles one
+kernel per padded-dimension bucket, not per cycle. A whole multi-DM batch
+runs the same program under ``jax.vmap``; sharding the DM axis over a
+device mesh (see :mod:`riptide_tpu.parallel`) distributes the batch with
+no code change here.
+
+Replaces the reference's single-threaded C++ search loop
+(riptide/cpp/periodogram.hpp:117-201) and its per-DM-trial OS process
+parallelism (riptide/pipeline/worker_pool.py) with one SPMD program.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.downsample import downsample_gather, split_prefix_sums
+from ..ops.ffa import ffa_levels
+from ..ops.snr import snr_batched
+
+__all__ = ["run_periodogram", "run_periodogram_batch", "cycle_fn"]
+
+
+def _pack(xd, p, m, R, P):
+    """
+    Pack a downsampled series into the (B, R, P) FFA container:
+    container[b, i, j] = xd[i * p[b] + j] for i < m[b], j < p[b], else 0.
+    """
+    B = p.shape[0]
+    rows = jnp.arange(R, dtype=jnp.int32)[None, :, None]
+    cols = jnp.arange(P, dtype=jnp.int32)[None, None, :]
+    pb = p[:, None, None]
+    mb = m[:, None, None]
+    idx = rows * pb + cols
+    valid = (rows < mb) & (cols < pb)
+    n = xd.shape[0]
+    flat = jnp.take(xd, jnp.clip(idx, 0, n - 1).reshape(-1)).reshape(B, R, P)
+    return jnp.where(valid, flat, 0.0)
+
+
+def _cycle_impl(x, cs_hi, cs_lo, ds, h, t, shift, p, m, hcoef, bcoef, stdnoise, widths, P):
+    imin, imax, wmin, wmax, wint = ds
+    xd = downsample_gather(x, cs_hi, cs_lo, imin, imax, wmin, wmax, wint)
+    R = h.shape[2]
+    buf = _pack(xd, p, m, R, P)
+    tbuf = ffa_levels(buf, h, t, shift, p)
+    return snr_batched(tbuf, p, widths, hcoef, bcoef, stdnoise)
+
+
+@partial(jax.jit, static_argnames=("widths", "P"))
+def cycle_fn(x, cs_hi, cs_lo, ds, h, t, shift, p, m, hcoef, bcoef, stdnoise, widths, P):
+    """
+    One cascade cycle on device.
+
+    x : (N,) float32 original series
+    cs_hi, cs_lo : (N + 1,) float32 hi/lo split prefix sums of x
+    ds : tuple of (imin, imax, wmin, wmax, wint), each (nout,)
+    h, t, shift : (L, B, R) int32 FFA level tables
+    p, m : (B,) int32 problem shapes
+    hcoef, bcoef : (B, NW) float32 boxcar coefficients
+    stdnoise : (B,) float32
+    widths : static tuple of ints; P : static padded bin count
+
+    Returns (B, R, NW) float32 S/N container; caller slices valid rows.
+    """
+    return _cycle_impl(
+        x, cs_hi, cs_lo, ds, h, t, shift, p, m, hcoef, bcoef, stdnoise, widths, P
+    )
+
+
+@partial(jax.jit, static_argnames=("widths", "P"))
+def cycle_fn_batch(x, cs_hi, cs_lo, ds, h, t, shift, p, m, hcoef, bcoef, stdnoise, widths, P):
+    """Vmapped :func:`cycle_fn` over a leading DM axis of the data; plan
+    operands are shared across the batch."""
+
+    def one(xx, hh, ll):
+        return _cycle_impl(
+            xx, hh, ll, ds, h, t, shift, p, m, hcoef, bcoef, stdnoise, widths, P
+        )
+
+    return jax.vmap(one)(x, cs_hi, cs_lo)
+
+
+def _stage_operands(st):
+    """Device operands of a CycleStage, memoized on the stage so repeated
+    searches with a cached plan ship only the data, not the tables."""
+    ops = getattr(st, "_device_operands", None)
+    if ops is None:
+        b = st.batch
+        ops = dict(
+            ds=tuple(jnp.asarray(a) for a in st.ds_plan),
+            h=jnp.asarray(b.h),
+            t=jnp.asarray(b.t),
+            shift=jnp.asarray(b.shift),
+            p=jnp.asarray(b.p),
+            m=jnp.asarray(b.m),
+            hcoef=jnp.asarray(st.hcoef),
+            bcoef=jnp.asarray(st.bcoef),
+            stdnoise=jnp.asarray(st.stdnoise),
+        )
+        st._device_operands = ops
+    return ops
+
+
+def _assemble(plan, raw_per_stage):
+    """
+    Trim each stage's (B, R, NW) S/N container to the evaluated rows and
+    concatenate in the reference's output order (cycle, bins, shift).
+    raw_per_stage: list of host numpy arrays.
+    """
+    nw = len(plan.widths)
+    chunks = []
+    for st, raw in zip(plan.stages, raw_per_stage):
+        for i, re in enumerate(st.rows_eval):
+            if re:
+                chunks.append(raw[i, :re, :])
+    if chunks:
+        return np.ascontiguousarray(np.concatenate(chunks, axis=0), dtype=np.float32)
+    return np.empty((0, nw), np.float32)
+
+
+def run_periodogram(plan, data):
+    """
+    Execute a :class:`~riptide_tpu.search.plan.PeriodogramPlan` on a single
+    normalised series.
+
+    Returns (periods float64, foldbins uint32, snrs float32 (len, NW)) with
+    the exact output contract of the reference's ``libcpp.periodogram``
+    (riptide/cpp/python_bindings.cpp:168-197).
+    """
+    data = np.asarray(data, dtype=np.float32)
+    if data.size != plan.size:
+        raise ValueError("data length does not match plan size")
+    hi, lo = split_prefix_sums(data)
+    x = jnp.asarray(data)
+    cs_hi = jnp.asarray(hi)
+    cs_lo = jnp.asarray(lo)
+    outs = []
+    for st in plan.stages:
+        ops = _stage_operands(st)
+        outs.append(
+            cycle_fn(
+                x, cs_hi, cs_lo, ops["ds"], ops["h"], ops["t"], ops["shift"],
+                ops["p"], ops["m"], ops["hcoef"], ops["bcoef"], ops["stdnoise"],
+                widths=plan.widths, P=plan.P,
+            )
+        )
+    # One host sync at the end: device work for all cycles is queued
+    # asynchronously, then gathered.
+    raw = [np.asarray(o) for o in outs]
+    snrs = _assemble(plan, raw)
+    return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs
+
+
+def run_periodogram_batch(plan, batch):
+    """
+    Execute the plan over a (D, N) stack of normalised series (one per DM
+    trial) in a single vmapped program per cycle.
+
+    Returns (periods, foldbins, snrs (D, len, NW)).
+    """
+    batch = np.asarray(batch, dtype=np.float32)
+    if batch.ndim != 2 or batch.shape[1] != plan.size:
+        raise ValueError("batch must be (D, N) with N matching the plan")
+    his, los = zip(*(split_prefix_sums(row) for row in batch))
+    x = jnp.asarray(batch)
+    cs_hi = jnp.asarray(np.stack(his))
+    cs_lo = jnp.asarray(np.stack(los))
+    outs = []
+    for st in plan.stages:
+        ops = _stage_operands(st)
+        outs.append(
+            cycle_fn_batch(
+                x, cs_hi, cs_lo, ops["ds"], ops["h"], ops["t"], ops["shift"],
+                ops["p"], ops["m"], ops["hcoef"], ops["bcoef"], ops["stdnoise"],
+                widths=plan.widths, P=plan.P,
+            )
+        )
+    raw = [np.asarray(o) for o in outs]  # (D, B, R, NW) each
+    snrs = np.stack(
+        [_assemble(plan, [r[d] for r in raw]) for d in range(batch.shape[0])]
+    )
+    return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs

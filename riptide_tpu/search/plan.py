@@ -1,0 +1,191 @@
+"""
+Host-side periodogram planning: the geometric downsampling cascade.
+
+The reference's search loop (riptide/cpp/periodogram.hpp:117-201) runs,
+for each downsampling factor f = ds_ini * ds_geo^i, an FFA transform and
+boxcar S/N evaluation for every phase-bin count in [bins_min, bstop].
+All of that control flow is *static* given (size, tsamp, period range,
+bins range): here we unroll it once on the host, in float64, into a list
+of :class:`CycleStage` objects holding
+
+* the downsampling gather plan for the cycle,
+* an :class:`~riptide_tpu.ops.plan.FFABatchPlan` packing every bins-trial
+  of the cycle into one padded (B, R, P) kernel launch,
+* per-trial noise normalisations, boxcar coefficients, evaluated row
+  counts, and float64 trial periods.
+
+The device then executes each cycle as a single compiled program with no
+data-dependent shapes; everything data-dependent (trial periods, output
+sizing — the reference's ``periodogram_length``) lives in this plan.
+
+Shape bucketing: the padded row count R is rounded up to the next value
+of the form 2^k or 1.5*2^k, so consecutive cycles whose row counts shrink
+geometrically (by ds_geo ~ 1.09) share compiled kernels, bounding
+XLA retraces to O(log(m_max)) per search configuration.
+"""
+from functools import lru_cache
+import math
+
+import numpy as np
+
+from ..ops.plan import FFABatchPlan
+from ..ops.reference import downsampled_size, downsampled_variance
+from ..ops.snr import boxcar_coeffs
+from ..ops.downsample import downsample_plan_padded
+
+__all__ = ["PeriodogramPlan", "periodogram_plan", "check_arguments", "ceilshift"]
+
+
+def check_arguments(size, tsamp, period_min, period_max, bins_min, bins_max):
+    """Argument validation, mirroring riptide/cpp/periodogram.hpp:25-40."""
+    if not tsamp > 0:
+        raise ValueError("tsamp must be > 0")
+    if not period_min > 0:
+        raise ValueError("period_min must be > 0")
+    if not period_max > period_min:
+        raise ValueError("period_max must be > period_min")
+    if not bins_min > 1:
+        raise ValueError("bins_min must be > 1")
+    if not bins_max >= bins_min:
+        raise ValueError("bins_max must be >= bins_min")
+    if not period_min >= tsamp * bins_min:
+        raise ValueError("Must have: period_min >= tsamp * bins_min")
+
+
+def ceilshift(rows, cols, pmax):
+    """
+    First FFA row whose trial period reaches ``pmax`` (in samples); rows
+    [0, ceilshift) have trial periods below it
+    (riptide/cpp/periodogram.hpp:54-57).
+    """
+    return int(math.ceil(cols * (rows - 1.0) * (1.0 - cols / pmax)))
+
+
+def _round_bucket(n):
+    """Round up to the next 2^k or 1.5*2^k for compile-cache reuse."""
+    if n <= 8:
+        return 8
+    k = int(math.floor(math.log2(n)))
+    for cand in (1 << k, 3 << (k - 1), 1 << (k + 1)):
+        if cand >= n:
+            return cand
+    return 1 << (k + 1)
+
+
+class CycleStage:
+    """One downsampling cycle of the periodogram cascade. See module doc."""
+
+    def __init__(self, size, tsamp, f, period_max, bins_min, bins_max, widths, nout):
+        self.f = f
+        self.tau = tau = f * tsamp
+        self.n = n = downsampled_size(size, f)
+        pms = period_max / tau  # period_max in units of current samples
+        bstart = bins_min
+        bstop = min(bins_max, n, int(pms))
+
+        self.bins = list(range(bstart, bstop + 1))
+        self.active = bool(self.bins)
+        if not self.active:
+            return
+
+        ms = [n // b for b in self.bins]
+        var = downsampled_variance(size, f)
+
+        self.rows_eval = []
+        self.periods = []
+        for b, rows in zip(self.bins, ms):
+            period_ceil = min(pms, b + 1.0)
+            rows_eval = min(rows, ceilshift(rows, b, period_ceil))
+            rows_eval = max(rows_eval, 0)
+            self.rows_eval.append(rows_eval)
+            s = np.arange(rows_eval, dtype=np.float64)
+            # float64 trial periods (riptide/cpp/periodogram.hpp:190-194)
+            self.periods.append(tau * b * b / (b - s / (rows - 1.0)) if rows_eval else np.empty(0))
+
+        # Pad the bins-trial batch to a constant B = bins_max - bins_min + 1
+        # and P = bins_max for ALL cycles, so the tail of the cascade (where
+        # bstop shrinks) reuses the compiled kernels of the main body.
+        # Dummy problems have m = 1 / rows_eval = 0 and are never read back.
+        B = bins_max - bins_min + 1
+        pad = B - len(self.bins)
+        ms_padded = ms + [1] * pad
+        ps_padded = self.bins + [bins_min] * pad
+        stds = np.asarray(ms, np.float64) * var
+        self.stdnoise = np.sqrt(
+            np.concatenate([stds, np.ones(pad)])
+        ).astype(np.float32)
+
+        R = _round_bucket(max(ms) + 1)
+        # L tied to the R bucket => one compiled kernel per bucket.
+        self.batch = FFABatchPlan(
+            ms_padded, ps_padded, R=R, P=bins_max, L=int(math.ceil(math.log2(R)))
+        )
+        nw = len(widths)
+        self.hcoef = np.zeros((B, nw), np.float32)
+        self.bcoef = np.zeros((B, nw), np.float32)
+        for i, b in enumerate(self.bins):
+            h, bb = boxcar_coeffs(b, widths)
+            self.hcoef[i], self.bcoef[i] = h, bb
+
+        self.ds_plan = downsample_plan_padded(size, f, nout)
+        self.length = sum(self.rows_eval)
+
+
+class PeriodogramPlan:
+    """
+    Full static plan of a periodogram search: the list of active
+    :class:`CycleStage` s plus output bookkeeping. Replicates the output
+    contract of the reference's ``libcpp.periodogram``
+    (riptide/cpp/python_bindings.cpp:168-197): float64 trial periods,
+    uint32 fold bin counts, float32 (num_periods, num_widths) S/N, ordered
+    by cycle then by phase-bin count then by shift.
+    """
+
+    def __init__(self, size, tsamp, widths, period_min, period_max, bins_min, bins_max):
+        check_arguments(size, tsamp, period_min, period_max, bins_min, bins_max)
+        widths = tuple(int(w) for w in widths)
+        if not all(0 < w < bins_min for w in widths):
+            raise ValueError("trial widths must be all > 0 and < bins_min")
+        self.size = int(size)
+        self.tsamp = float(tsamp)
+        self.widths = widths
+        self.period_min = float(period_min)
+        self.period_max = float(period_max)
+        self.bins_min = int(bins_min)
+        self.bins_max = int(bins_max)
+
+        ds_ini = period_min / (tsamp * bins_min)
+        ds_geo = (bins_max + 1.0) / bins_min
+        num_ds = int(math.ceil(math.log(period_max / period_min) / math.log(ds_geo)))
+        # Largest per-cycle buffer; every cycle's downsample output is
+        # padded to this length so all cycles share gather kernels.
+        self.nout = downsampled_size(size, ds_ini)
+        self.P = int(bins_max)
+
+        self.stages = []
+        for ids in range(num_ds):
+            f = ds_ini * ds_geo**ids
+            st = CycleStage(size, tsamp, f, period_max, bins_min, bins_max, widths, self.nout)
+            if st.active and st.length > 0:
+                self.stages.append(st)
+
+        self.length = sum(st.length for st in self.stages)
+        # Assembled float64 periods / uint32 foldbins, fixed at plan time.
+        self.all_periods = (
+            np.concatenate([p for st in self.stages for p in st.periods])
+            if self.length
+            else np.empty(0)
+        )
+        self.all_foldbins = np.concatenate(
+            [
+                np.full(re, b, np.uint32)
+                for st in self.stages
+                for b, re in zip(st.bins, st.rows_eval)
+            ]
+        ) if self.length else np.empty(0, np.uint32)
+
+
+@lru_cache(maxsize=64)
+def periodogram_plan(size, tsamp, widths, period_min, period_max, bins_min, bins_max):
+    """Cached :class:`PeriodogramPlan`; ``widths`` must be a tuple."""
+    return PeriodogramPlan(size, tsamp, widths, period_min, period_max, bins_min, bins_max)

@@ -1,0 +1,206 @@
+"""
+TimeSeries: the core input container for FFA searches.
+Reference contract: riptide/time_series.py. Data lives on the host as
+float32 numpy; device transfer happens inside the search/detrending ops.
+"""
+import copy
+import warnings
+
+import numpy as np
+
+from .folding import fold
+from .libffa import downsample, generate_signal
+from .metadata import Metadata
+from .running_medians import fast_running_median
+from .timing import timing
+
+
+class TimeSeries:
+    """
+    Container for dedispersed time series data to be searched with the
+    FFA. **Use classmethods to create new TimeSeries objects.**
+
+    Parameters
+    ----------
+    data : array_like
+        Time series samples (stored as float32).
+    tsamp : float
+        Sampling time in seconds.
+    metadata : Metadata or dict, optional
+    copy : bool, optional
+        Copy the data instead of referencing it.
+    """
+
+    def __init__(self, data, tsamp, metadata=None, copy=False):
+        if copy:
+            self._data = np.asarray(data, dtype=np.float32).copy()
+        else:
+            self._data = np.asarray(data, dtype=np.float32)
+        self._tsamp = float(tsamp)
+        self.metadata = Metadata(metadata) if metadata is not None else Metadata({})
+        # tobs is kept for downstream stages (peak detection thresholds)
+        self.metadata["tobs"] = self.length
+
+    @property
+    def data(self):
+        """float32 numpy array of samples."""
+        return self._data
+
+    @property
+    def tsamp(self):
+        """Sampling time in seconds."""
+        return self._tsamp
+
+    @property
+    def nsamp(self):
+        """Number of samples."""
+        return self._data.size
+
+    @property
+    def length(self):
+        """Data length in seconds."""
+        return self.nsamp * self.tsamp
+
+    @property
+    def tobs(self):
+        """Alias of :attr:`length`."""
+        return self.length
+
+    def copy(self):
+        return copy.deepcopy(self)
+
+    def normalise(self, inplace=False):
+        """
+        Normalise to zero mean and unit variance, with float64 accumulators
+        to avoid saturation on large-valued data
+        (riptide/time_series.py:66-90).
+        """
+        m = self.data.mean(dtype=np.float64)
+        v = self.data.var(dtype=np.float64)
+        norm = v**0.5
+        if inplace:
+            self._data = ((self.data - m) / norm).astype(np.float32)
+        else:
+            return TimeSeries((self.data - m) / norm, self.tsamp, metadata=self.metadata)
+
+    @timing
+    def deredden(self, width, minpts=101, inplace=False):
+        """
+        Subtract an approximate running median of window ``width`` seconds
+        (computed on a scrunched copy, then upsampled — see
+        :func:`riptide_tpu.running_medians.fast_running_median`).
+        """
+        width_samples = int(round(width / self.tsamp))
+        rmed = fast_running_median(self.data, width_samples, minpts).astype(np.float32)
+        if inplace:
+            self._data = self._data - rmed
+        else:
+            return TimeSeries(self.data - rmed, self.tsamp, metadata=self.metadata)
+
+    def downsample(self, factor, inplace=False):
+        """Downsample by a real-valued factor > 1."""
+        if inplace:
+            self._data = downsample(self.data, factor)
+            self._tsamp *= factor
+        else:
+            return TimeSeries(
+                downsample(self.data, factor), factor * self.tsamp, metadata=self.metadata
+            )
+
+    def fold(self, period, bins, subints=None):
+        """Fold at ``period`` seconds into ``bins`` phase bins; see
+        :func:`riptide_tpu.folding.fold`."""
+        return fold(self, period, bins, subints=subints)
+
+    @classmethod
+    def generate(cls, length, tsamp, period, phi0=0.5, ducy=0.02, amplitude=10.0, stdnoise=1.0):
+        """
+        Generate a noisy time series containing a periodic von Mises pulse
+        train (fake pulsar). The expected matched-filter S/N is
+        amplitude / stdnoise; see :func:`riptide_tpu.libffa.generate_signal`.
+        """
+        nsamp = int(round(length / tsamp))
+        data = generate_signal(
+            nsamp,
+            period / tsamp,
+            phi0=phi0,
+            ducy=ducy,
+            amplitude=amplitude,
+            stdnoise=stdnoise,
+        )
+        metadata = Metadata(
+            {
+                "source_name": "fake",
+                "signal_shape": "Von Mises",
+                "signal_period": period,
+                "signal_initial_phase": phi0,
+                "signal_duty_cycle": ducy,
+            }
+        )
+        return cls(data, tsamp, copy=False, metadata=metadata)
+
+    @classmethod
+    def from_numpy_array(cls, array, tsamp, copy=False):
+        """From a plain array of samples."""
+        return cls(array, tsamp, copy=copy)
+
+    @classmethod
+    def from_binary(cls, fname, tsamp, dtype=np.float32):
+        """From a headerless binary file of raw samples."""
+        data = np.fromfile(fname, dtype=dtype)
+        return cls(data, tsamp, metadata=Metadata({"fname": fname}))
+
+    @classmethod
+    def from_npy_file(cls, fname, tsamp):
+        """From a .npy array file."""
+        data = np.load(fname)
+        return cls(data, tsamp, metadata=Metadata({"fname": fname}))
+
+    @classmethod
+    @timing
+    def from_presto_inf(cls, fname):
+        """
+        From a PRESTO .inf header (loads the companion .dat file). Warns
+        on X-ray/Gamma data, whose white-noise statistics assumption does
+        not hold (riptide/time_series.py:283-316).
+        """
+        from .reading import PrestoInf
+
+        inf = PrestoInf(fname)
+        metadata = Metadata.from_presto_inf(inf)
+        if metadata.get("em_band", None) in ("X-ray", "Gamma"):
+            warnings.warn(
+                "Loading X-ray or Gamma-ray data: the FFA search assumes "
+                "Gaussian white noise, which photon-counting data generally "
+                "violate. Interpret S/N values with caution."
+            )
+        return cls(inf.load_data(), metadata["tsamp"], metadata=metadata)
+
+    @classmethod
+    @timing
+    def from_sigproc(cls, fname, extra_keys=None):
+        """
+        From a SIGPROC dedispersed time series (32-bit float, or 8-bit
+        with the 'signed' header key; riptide/time_series.py:318-362).
+        """
+        from .reading import SigprocHeader
+
+        sh = SigprocHeader(fname, extra_keys=extra_keys or {})
+        metadata = Metadata.from_sigproc(sh)
+        nbits = sh["nbits"]
+        with open(fname, "rb") as fobj:
+            fobj.seek(sh.bytesize)
+            if nbits == 32:
+                data = np.fromfile(fobj, dtype=np.float32)
+            elif sh["signed"]:
+                data = np.fromfile(fobj, dtype=np.int8).astype(np.float32)
+            else:
+                data = np.fromfile(fobj, dtype=np.uint8).astype(np.float32)
+        return cls(data, metadata["tsamp"], metadata=metadata)
+
+    def to_dict(self):
+        return {"data": self.data, "tsamp": self.tsamp, "metadata": self.metadata}
+
+    @classmethod
+    def from_dict(cls, items):
+        return cls(items["data"], items["tsamp"], metadata=items["metadata"])

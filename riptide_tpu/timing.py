@@ -1,0 +1,21 @@
+"""Wall-clock timing decorator logging to the 'riptide_tpu.timing' logger
+at DEBUG level (reference: riptide/timing.py)."""
+import logging
+import time
+from functools import wraps
+
+log = logging.getLogger("riptide_tpu.timing")
+
+__all__ = ["timing"]
+
+
+def timing(func):
+    @wraps(func)
+    def wrapper(*args, **kwargs):
+        start = time.time()
+        result = func(*args, **kwargs)
+        runtime_ms = (time.time() - start) * 1000.0
+        log.debug(f"{func.__name__} time: {runtime_ms:.2f} ms")
+        return result
+
+    return wrapper

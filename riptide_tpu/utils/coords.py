@@ -1,0 +1,96 @@
+"""
+Minimal sky-coordinate support (astropy is not a dependency).
+
+Provides just what the framework needs from astropy's SkyCoord in the
+reference (riptide/reading/*.py, riptide/pipeline/dmiter.py:120-133):
+ICRS RA/Dec storage, parsing from PRESTO sexagesimal strings and SIGPROC
+packed floats, galactic latitude (for the DM * |sin b| cap), equality,
+and JSON round-tripping.
+"""
+import math
+
+__all__ = ["SkyCoord", "parse_sexagesimal", "parse_sigproc_float_coord"]
+
+# ICRS coordinates of the north galactic pole and the galactic longitude
+# of the ascending node of the galactic plane (J2000, IAU definition).
+_RA_NGP = math.radians(192.85948)
+_DEC_NGP = math.radians(27.12825)
+_L_NCP = math.radians(122.93192)
+
+
+def parse_sexagesimal(s):
+    """Parse '[+-]hh:mm:ss.sss' (or dd:mm:ss.sss) to a float in the
+    leading unit (hours or degrees)."""
+    s = s.strip()
+    sign = -1.0 if s.startswith("-") else 1.0
+    parts = s.lstrip("+-").split(":")
+    val = 0.0
+    for i, part in enumerate(parts):
+        val += abs(float(part)) / 60.0**i
+    return sign * val
+
+
+def parse_sigproc_float_coord(f):
+    """
+    Parse SIGPROC's packed ddmmss.s float coordinate to hours (RA) or
+    degrees (Dec) (riptide/reading/sigproc.py:148-156).
+    """
+    sign = -1.0 if f < 0 else 1.0
+    x = abs(f)
+    hh, x = divmod(x, 10000.0)
+    mm, ss = divmod(x, 100.0)
+    return sign * (hh + mm / 60.0 + ss / 3600.0)
+
+
+class SkyCoord:
+    """ICRS sky position in degrees, hashable and JSON round-trippable."""
+
+    def __init__(self, ra_deg, dec_deg):
+        self.ra_deg = float(ra_deg)
+        self.dec_deg = float(dec_deg)
+
+    @classmethod
+    def from_radec_str(cls, raj, decj):
+        """From PRESTO-style 'hh:mm:ss.ssss' RA and 'dd:mm:ss.ss' Dec."""
+        return cls(parse_sexagesimal(raj) * 15.0, parse_sexagesimal(decj))
+
+    @classmethod
+    def from_sigproc(cls, src_raj, src_dej):
+        """From SIGPROC packed-float src_raj (hours) / src_dej (degrees)."""
+        return cls(parse_sigproc_float_coord(src_raj) * 15.0, parse_sigproc_float_coord(src_dej))
+
+    @property
+    def galactic(self):
+        """(l, b) galactic coordinates in degrees."""
+        ra = math.radians(self.ra_deg)
+        dec = math.radians(self.dec_deg)
+        sb = math.sin(dec) * math.sin(_DEC_NGP) + math.cos(dec) * math.cos(
+            _DEC_NGP
+        ) * math.cos(ra - _RA_NGP)
+        b = math.asin(max(-1.0, min(1.0, sb)))
+        y = math.cos(dec) * math.sin(ra - _RA_NGP)
+        x = math.sin(dec) * math.cos(_DEC_NGP) - math.cos(dec) * math.sin(
+            _DEC_NGP
+        ) * math.cos(ra - _RA_NGP)
+        l = (_L_NCP - math.atan2(y, x)) % (2.0 * math.pi)
+        return math.degrees(l), math.degrees(b)
+
+    def to_dict(self):
+        return {"ra_deg": self.ra_deg, "dec_deg": self.dec_deg}
+
+    @classmethod
+    def from_dict(cls, items):
+        return cls(items["ra_deg"], items["dec_deg"])
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, SkyCoord)
+            and abs(self.ra_deg - other.ra_deg) < 1e-9
+            and abs(self.dec_deg - other.dec_deg) < 1e-9
+        )
+
+    def __hash__(self):
+        return hash((round(self.ra_deg, 9), round(self.dec_deg, 9)))
+
+    def __repr__(self):
+        return f"SkyCoord(ra={self.ra_deg:.6f} deg, dec={self.dec_deg:.6f} deg)"

@@ -5,7 +5,9 @@ keep everything deterministic.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment ships with JAX_PLATFORMS=axon
+# (the TPU tunnel) and the single TPU chip must not be contended by tests.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,115 @@
+"""
+Synthetic data-file writers for tests: produce PRESTO .inf/.dat pairs and
+SIGPROC dedispersed time series with known content, so the readers and
+the end-to-end apps can be exercised without real telescope data.
+(Same role as riptide/tests/presto_generation.py and the checked-in
+fixtures in riptide/tests/data/.)
+"""
+import os
+import struct
+
+import numpy as np
+
+INF_TEMPLATE = """\
+ Data file name without suffix          =  {basename}
+ Telescope used                         =  Parkes
+ Instrument used                        =  Multibeam
+ Object being observed                  =  Pulsar
+ J2000 Right Ascension (hh:mm:ss.ssss)  =  00:00:01.0000
+ J2000 Declination     (dd:mm:ss.ssss)  =  -00:00:01.0000
+ Data observed by                       =  Test Suite
+ Epoch of observation (MJD)             =  59000.000000
+ Barycentered?           (1=yes, 0=no)  =  1
+ Number of bins in the time series      =  {nsamp}
+ Width of each time series bin (sec)    =  {tsamp:.12e}
+ Any breaks in the data? (1=yes, 0=no)  =  0
+ Type of observation (EM band)          =  Radio
+ Beam diameter (arcsec)                 =  981
+ Dispersion measure (cm-3 pc)           =  {dm:.12f}
+ Central freq of low channel (Mhz)      =  1182.1953125
+ Total bandwidth (Mhz)                  =  400
+ Number of channels                     =  1024
+ Channel bandwidth (Mhz)                =  0.390625
+ Data analyzed by                       =  Test Suite
+ Any additional notes:
+    Synthetic data written by the riptide_tpu test suite.
+"""
+
+
+def _pad_inf(text):
+    """Align the '=' of each header line to column 40 as PRESTO does."""
+    out = []
+    for line in text.splitlines():
+        if "=" in line:
+            # rpartition: keys like "Barycentered? (1=yes, 0=no)" contain '='
+            key, _, val = line.rpartition("=")
+            out.append(key.ljust(40)[:40] + "=" + val)
+        else:
+            out.append(line)
+    return "\n".join(out) + "\n"
+
+
+def write_presto(outdir, basename, data, tsamp, dm=0.0):
+    """Write a float32 array as a PRESTO .inf/.dat pair; returns the .inf
+    path."""
+    data = np.asarray(data, dtype=np.float32)
+    inf_text = _pad_inf(
+        INF_TEMPLATE.format(basename=basename, nsamp=data.size, tsamp=tsamp, dm=dm)
+    )
+    inf_path = os.path.join(outdir, f"{basename}.inf")
+    with open(inf_path, "w") as fobj:
+        fobj.write(inf_text)
+    data.tofile(os.path.join(outdir, f"{basename}.dat"))
+    return inf_path
+
+
+def generate_data_presto(outdir, basename, tobs=128.0, tsamp=256e-6, period=1.0,
+                         dm=0.0, amplitude=20.0, ducy=0.05):
+    """
+    Seeded fake-pulsar PRESTO files (np.random.seed(0)), matching the
+    deterministic generation of riptide/tests/presto_generation.py so the
+    S/N oracle values carry over. Returns the .inf path.
+    """
+    from riptide_tpu import TimeSeries
+
+    np.random.seed(0)
+    ts = TimeSeries.generate(tobs, tsamp, period, amplitude=amplitude, ducy=ducy, stdnoise=1.0)
+    return write_presto(outdir, basename, ts.data, tsamp, dm=dm)
+
+
+def _sigproc_str(s):
+    b = s.encode()
+    return struct.pack("i", len(b)) + b
+
+
+def write_sigproc(path, data, tsamp, nbits=32, signed=None, refdm=0.0,
+                  src_raj=1.0, src_dej=-1.0, source_name="Pulsar", tstart=59000.0):
+    """
+    Write a single-channel SIGPROC dedispersed time series. nbits 32
+    writes float32; nbits 8 writes int8/uint8 depending on ``signed``
+    (pass signed=None to omit the 'signed' header key entirely, which
+    readers must reject for 8-bit data).
+    """
+    data = np.asarray(data)
+    hdr = _sigproc_str("HEADER_START")
+    hdr += _sigproc_str("source_name") + _sigproc_str(source_name)
+    hdr += _sigproc_str("src_raj") + struct.pack("d", src_raj)
+    hdr += _sigproc_str("src_dej") + struct.pack("d", src_dej)
+    hdr += _sigproc_str("tstart") + struct.pack("d", tstart)
+    hdr += _sigproc_str("tsamp") + struct.pack("d", tsamp)
+    hdr += _sigproc_str("nbits") + struct.pack("i", nbits)
+    hdr += _sigproc_str("nchans") + struct.pack("i", 1)
+    hdr += _sigproc_str("nifs") + struct.pack("i", 1)
+    hdr += _sigproc_str("refdm") + struct.pack("d", refdm)
+    if signed is not None:
+        hdr += _sigproc_str("signed") + struct.pack("B", int(signed))
+    hdr += _sigproc_str("HEADER_END")
+    if nbits == 32:
+        payload = data.astype(np.float32).tobytes()
+    elif signed:
+        payload = data.astype(np.int8).tobytes()
+    else:
+        payload = data.astype(np.uint8).tobytes()
+    with open(path, "wb") as fobj:
+        fobj.write(hdr + payload)
+    return path

@@ -1,0 +1,114 @@
+"""
+Search-level tests: periodogram engine vs the slow numpy oracle, and the
+end-to-end S/N parity bar on a seeded synthetic pulsar (S/N 18.5 +/- 0.15
+— the same deterministic oracle as riptide/tests/test_rseek.py:50-54).
+"""
+import numpy as np
+import pytest
+
+from riptide_tpu import TimeSeries, ffa_search, generate_width_trials
+from riptide_tpu.ops.reference import periodogram_ref
+from riptide_tpu.search import periodogram_plan, run_periodogram, run_periodogram_batch
+
+
+@pytest.fixture(scope="module")
+def small_cfg():
+    rng = np.random.RandomState(0)
+    data = rng.normal(size=8192).astype(np.float32)
+    data = ((data - data.mean()) / data.std()).astype(np.float32)
+    return data, dict(tsamp=0.001, period_min=0.025, period_max=0.1, bins_min=24, bins_max=26)
+
+
+def test_engine_matches_oracle(small_cfg):
+    data, cfg = small_cfg
+    widths = generate_width_trials(cfg["bins_min"])
+    P1, F1, S1 = periodogram_ref(
+        data, cfg["tsamp"], widths, cfg["period_min"], cfg["period_max"],
+        cfg["bins_min"], cfg["bins_max"],
+    )
+    plan = periodogram_plan(
+        data.size, cfg["tsamp"], tuple(int(w) for w in widths),
+        cfg["period_min"], cfg["period_max"], cfg["bins_min"], cfg["bins_max"],
+    )
+    P2, F2, S2 = run_periodogram(plan, data)
+    assert len(P1) == len(P2) == plan.length
+    assert np.array_equal(F1, F2)
+    assert np.allclose(P1, P2, rtol=1e-12)
+    assert np.allclose(S1, S2, atol=2e-3)
+
+
+def test_engine_batch_matches_single(small_cfg):
+    data, cfg = small_cfg
+    widths = generate_width_trials(cfg["bins_min"])
+    plan = periodogram_plan(
+        data.size, cfg["tsamp"], tuple(int(w) for w in widths),
+        cfg["period_min"], cfg["period_max"], cfg["bins_min"], cfg["bins_max"],
+    )
+    rng = np.random.RandomState(1)
+    batch = rng.normal(size=(3, data.size)).astype(np.float32)
+    batch[0] = data
+    P, F, S = run_periodogram_batch(plan, batch)
+    assert S.shape[0] == 3
+    P0, F0, S0 = run_periodogram(plan, data)
+    assert np.allclose(S[0], S0, atol=1e-4)
+    for d in (1, 2):
+        _, _, Sd = run_periodogram(plan, batch[d])
+        assert np.allclose(S[d], Sd, atol=1e-4)
+
+
+def test_periods_monotonic_and_shapes():
+    """Contract checks mirrored from riptide/tests/test_ffa_search_pgram.py:
+    monotone increasing trial periods, matching array lengths, decreasing
+    freqs."""
+    np.random.seed(42)
+    ts = TimeSeries.generate(length=20.0, tsamp=0.001, period=1.0, amplitude=15.0)
+    tsn, pgram = ffa_search(ts, period_min=0.5, period_max=2.0, bins_min=32, bins_max=36)
+    assert np.all(np.diff(pgram.periods) > 0)
+    assert pgram.snrs.shape == (pgram.periods.size, pgram.widths.size)
+    assert pgram.foldbins.size == pgram.periods.size
+    assert np.all(np.diff(pgram.freqs) < 0)
+    assert pgram.metadata is tsn.metadata
+    # trial periods span the requested range (up to bins/(bins+1) granularity)
+    assert pgram.periods[0] <= 0.5 * (1 + 1.0 / 32)
+    assert pgram.periods[-1] >= 2.0 * (1 - 1.0 / 32)
+
+
+def test_identity_contract():
+    """deredden=False + already_normalised=True must return the input
+    TimeSeries object itself (riptide/tests/test_ffa_search_pgram.py:41-47)."""
+    np.random.seed(0)
+    ts = TimeSeries.generate(length=20.0, tsamp=0.001, period=1.0, amplitude=0.0)
+    out, _ = ffa_search(
+        ts, period_min=0.5, period_max=1.0, bins_min=32, bins_max=36,
+        deredden=False, already_normalised=True,
+    )
+    assert out is ts
+
+
+def test_no_downsampling_edge_case():
+    """period_min == bins_min * tsamp => initial factor is exactly 1
+    (regression: riptide/tests/test_ffa_search_pgram.py:77-96)."""
+    np.random.seed(3)
+    ts = TimeSeries.generate(length=10.0, tsamp=0.001, period=0.1, amplitude=10.0)
+    _, pgram = ffa_search(ts, period_min=0.032, period_max=0.1, bins_min=32, bins_max=36)
+    assert pgram.periods.size > 0
+    assert np.all(np.diff(pgram.periods) > 0)
+
+
+def test_snr_parity_oracle():
+    """THE parity bar: seeded fake pulsar, P = 1 s, amplitude 20,
+    ducy 0.02, 128 s at 256 us sampling, searched with the rseek test's
+    options (P 0.5-2.0 s, bins 480-520, ducy_max 0.3): the best trial must
+    come out at S/N 18.5 +/- 0.15 with width 13 bins and frequency within
+    0.1/T of 1 Hz — the reference's deterministic end-to-end expectation
+    (riptide/tests/test_rseek.py:17,31-54, tests/presto_generation.py:46)."""
+    np.random.seed(0)
+    ts = TimeSeries.generate(length=128.0, tsamp=256e-6, period=1.0, amplitude=20.0, ducy=0.02)
+    _, pgram = ffa_search(
+        ts, period_min=0.5, period_max=2.0, bins_min=480, bins_max=520, ducy_max=0.3
+    )
+    ip, iw = np.unravel_index(np.argmax(pgram.snrs), pgram.snrs.shape)
+    best_snr = pgram.snrs[ip, iw]
+    assert abs(1.0 / pgram.periods[ip] - 1.0) < 0.1 / 128.0
+    assert int(pgram.widths[iw]) == 13
+    assert abs(best_snr - 18.5) < 0.15

@@ -1,0 +1,162 @@
+"""
+TimeSeries / Metadata / readers / serialization tests using synthetic
+fixture files (mirrors riptide/tests/test_time_series.py with 16-sample
+fixtures of integers 0..15 at 64 us sampling).
+"""
+import numpy as np
+import pytest
+
+from riptide_tpu import TimeSeries, Metadata, save_json, load_json
+from riptide_tpu.utils.coords import SkyCoord
+
+from synth import write_presto, write_sigproc
+
+TSAMP = 64e-6
+DATA16 = np.arange(16, dtype=np.float32)
+
+
+def test_from_presto(tmp_path):
+    inf = write_presto(str(tmp_path), "fix16", DATA16, TSAMP, dm=12.5)
+    ts = TimeSeries.from_presto_inf(inf)
+    assert ts.data.dtype == np.float32
+    assert np.array_equal(ts.data, DATA16)
+    assert ts.tsamp == TSAMP
+    assert ts.nsamp == 16
+    assert ts.metadata["dm"] == 12.5
+    assert ts.metadata["source_name"] == "Pulsar"
+    assert isinstance(ts.metadata["skycoord"], SkyCoord)
+    assert abs(ts.metadata["mjd"] - 59000.0) < 1e-9
+
+
+def test_from_sigproc_float32(tmp_path):
+    path = write_sigproc(str(tmp_path / "f32.tim"), DATA16, TSAMP, nbits=32, refdm=7.0)
+    ts = TimeSeries.from_sigproc(path)
+    assert ts.data.dtype == np.float32
+    assert np.array_equal(ts.data, DATA16)
+    assert ts.metadata["dm"] == 7.0
+    assert abs(ts.metadata["mjd"] - 59000.0) < 1e-9
+
+
+def test_from_sigproc_uint8(tmp_path):
+    path = write_sigproc(str(tmp_path / "u8.tim"), DATA16, TSAMP, nbits=8, signed=False)
+    ts = TimeSeries.from_sigproc(path)
+    assert ts.data.dtype == np.float32
+    assert np.array_equal(ts.data, DATA16)
+
+
+def test_from_sigproc_int8(tmp_path):
+    data = DATA16 - 8
+    path = write_sigproc(str(tmp_path / "i8.tim"), data, TSAMP, nbits=8, signed=True)
+    ts = TimeSeries.from_sigproc(path)
+    assert np.array_equal(ts.data, data)
+
+
+def test_from_sigproc_8bit_without_signed_key_rejected(tmp_path):
+    path = write_sigproc(str(tmp_path / "bad.tim"), DATA16, TSAMP, nbits=8, signed=None)
+    with pytest.raises(ValueError):
+        TimeSeries.from_sigproc(path)
+
+
+def test_generate_properties():
+    np.random.seed(0)
+    ts = TimeSeries.generate(length=1.0, tsamp=0.001, period=0.1, amplitude=10.0)
+    assert ts.nsamp == 1000
+    assert ts.data.dtype == np.float32
+    assert abs(ts.length - 1.0) < 1e-9
+    assert ts.metadata["source_name"] == "fake"
+    # noiseless generation
+    ts0 = TimeSeries.generate(length=1.0, tsamp=0.001, period=0.1, amplitude=10.0, stdnoise=0.0)
+    # L2 norm of noiseless signal == amplitude
+    assert np.isclose(np.sqrt((ts0.data.astype(np.float64) ** 2).sum()), 10.0, rtol=1e-5)
+
+
+def test_normalise():
+    np.random.seed(1)
+    ts = TimeSeries.from_numpy_array(
+        np.random.normal(loc=50.0, scale=4.0, size=10000).astype(np.float32), 0.001
+    )
+    out = ts.normalise()
+    assert abs(out.data.mean()) < 1e-4
+    assert abs(out.data.std() - 1.0) < 1e-4
+    ts.normalise(inplace=True)
+    assert np.allclose(ts.data, out.data)
+
+
+def test_deredden_removes_baseline():
+    n = 20000
+    t = np.arange(n)
+    baseline = (10.0 * np.sin(2 * np.pi * t / n)).astype(np.float32)
+    np.random.seed(2)
+    noise = np.random.normal(size=n).astype(np.float32)
+    ts = TimeSeries.from_numpy_array(baseline + noise, 0.001)
+    out = ts.deredden(2.0)  # 2000-sample window
+    mid = slice(2000, n - 2000)
+    # baseline mostly gone in the interior
+    assert np.abs(out.data[mid].mean()) < 0.1
+    assert out.data[mid].std() < 1.5
+
+
+def test_downsample():
+    ts = TimeSeries.from_numpy_array(np.arange(8, dtype=np.float32), 1.0)
+    out = ts.downsample(2.0)
+    assert np.allclose(out.data, [1, 5, 9, 13])
+    assert out.tsamp == 2.0
+
+
+def test_fold_consistency():
+    """Folding semantics across subints variants
+    (riptide/tests/test_time_series.py:159-201)."""
+    np.random.seed(3)
+    ts = TimeSeries.generate(length=10.0, tsamp=0.001, period=1.0, amplitude=50.0, stdnoise=0.0)
+    full = ts.fold(1.0, 100, subints=None)
+    assert full.ndim == 2 and full.shape[1] == 100
+    one = ts.fold(1.0, 100, subints=1)
+    assert one.ndim == 1
+    assert np.allclose(one, full.sum(axis=0), atol=1e-4)
+    two = ts.fold(1.0, 100, subints=2)
+    assert two.shape == (2, 100)
+    # peak phase consistent across all variants
+    assert abs(int(full.sum(0).argmax()) - int(one.argmax())) <= 1
+    with pytest.raises(ValueError):
+        ts.fold(20.0, 100)  # period exceeds data length
+    with pytest.raises(ValueError):
+        ts.fold(0.05, 100)  # bin width below tsamp
+
+
+def test_json_roundtrip(tmp_path):
+    np.random.seed(4)
+    ts = TimeSeries.generate(length=0.5, tsamp=0.001, period=0.1, amplitude=5.0)
+    ts.metadata["skycoord"] = SkyCoord(12.3, -45.6)
+    path = str(tmp_path / "ts.json")
+    save_json(path, ts)
+    loaded = load_json(path)
+    assert isinstance(loaded, TimeSeries)
+    assert np.array_equal(loaded.data, ts.data)
+    assert loaded.tsamp == ts.tsamp
+    assert loaded.metadata["skycoord"] == ts.metadata["skycoord"]
+    assert loaded.metadata["signal_period"] == 0.1
+
+
+def test_metadata_validation():
+    with pytest.raises(ValueError):
+        Metadata({"dm": -1.0})
+    with pytest.raises(ValueError):
+        Metadata({"tobs": 0.0})
+    with pytest.raises(ValueError):
+        Metadata({"source_name": 42})
+    md = Metadata({"dm": 5.0, "custom": [1, 2, 3]})
+    assert md["dm"] == 5.0
+    assert md["skycoord"] is None  # missing reserved keys default to None
+    assert md["custom"] == [1, 2, 3]
+
+
+def test_galactic_coordinates():
+    # Galactic centre: (l, b) ~ (0, 0) at ra=266.405, dec=-28.936
+    gc = SkyCoord(266.40499, -28.93617)
+    l, b = gc.galactic
+    assert abs(b) < 0.01
+    assert l < 0.01 or l > 359.99
+    # North galactic pole
+    ngp = SkyCoord(192.85948, 27.12825)
+    _, b = ngp.galactic
+    assert abs(b - 90.0) < 0.01
