@@ -1,0 +1,27 @@
+"""
+Distributed execution layer: shard the DM-trial batch over a TPU mesh.
+
+The reference parallelises its multi-DM search with one OS process per DM
+trial (riptide/pipeline/worker_pool.py:36-44) and no communication backend
+beyond fork + pickle. Here the same data parallelism is expressed the TPU
+way: the (D, N) stack of dedispersed series lives in HBM sharded over the
+``dm`` axis of a :class:`jax.sharding.Mesh`, every chip runs the identical
+periodogram program on its local shard (SPMD via ``jax.shard_map``), and
+the tiny per-trial S/N results are gathered once at the end. A second
+optional ``bins`` mesh axis splits each cycle's phase-bin trial batch
+across chips — the tensor-parallel analog for when few DM trials must go
+wide.
+
+Multi-host: :func:`init_distributed` wraps ``jax.distributed.initialize``;
+all collectives ride XLA over ICI/DCN.
+"""
+from .mesh import default_mesh, mesh_2d
+from .sharded import run_periodogram_sharded
+from .distributed import init_distributed
+
+__all__ = [
+    "default_mesh",
+    "mesh_2d",
+    "run_periodogram_sharded",
+    "init_distributed",
+]

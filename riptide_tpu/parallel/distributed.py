@@ -1,0 +1,45 @@
+"""
+Multi-host initialisation.
+
+The reference has no communication backend at all — its inter-process
+data motion is fork + pickled ``Pool.map`` arguments
+(riptide/pipeline/worker_pool.py:36-44). The TPU equivalent of "scale
+past one node" is ``jax.distributed``: every host joins the same XLA
+runtime, ``jax.devices()`` becomes the global chip set, and the mesh in
+:mod:`riptide_tpu.parallel.mesh` spans hosts with collectives riding
+ICI within a slice and DCN across slices.
+"""
+import logging
+import os
+
+import jax
+
+log = logging.getLogger("riptide_tpu.distributed")
+
+__all__ = ["init_distributed"]
+
+
+def init_distributed(coordinator_address=None, num_processes=None, process_id=None):
+    """
+    Join (or create) a multi-host JAX runtime. Safe to call unconditionally:
+    a single-process run with no coordinator configured is a no-op.
+
+    Arguments default to the standard JAX environment variables /
+    cluster auto-detection (``jax.distributed.initialize`` semantics).
+    Returns True if a multi-process runtime was initialised.
+    """
+    if jax.process_count() > 1:
+        return True  # already initialised
+    explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if explicit is None and num_processes is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info(
+        "distributed runtime up: process %d/%d, %d global devices",
+        jax.process_index(), jax.process_count(), jax.device_count(),
+    )
+    return True

@@ -1,0 +1,28 @@
+"""Device-mesh construction helpers."""
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["default_mesh", "mesh_2d"]
+
+
+def default_mesh(devices=None, axis_name="dm"):
+    """1-D mesh over all (or the given) devices, for sharding the DM-trial
+    batch. This is the standard production layout: one DM shard per chip,
+    no inter-chip communication during the search itself."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def mesh_2d(devices=None, bins_shards=1, axis_names=("dm", "bins")):
+    """2-D (dm, bins) mesh: DM data parallelism x phase-bin-trial model
+    parallelism. ``bins_shards`` must divide the device count."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % bins_shards:
+        raise ValueError(f"bins_shards={bins_shards} does not divide {n} devices")
+    arr = np.asarray(devices).reshape(n // bins_shards, bins_shards)
+    return Mesh(arr, axis_names)

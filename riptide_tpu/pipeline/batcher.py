@@ -1,0 +1,145 @@
+"""
+Device batch searcher: the TPU-native replacement of the reference's
+process-per-DM-trial WorkerPool (riptide/pipeline/worker_pool.py).
+
+Where the reference forks one OS process per DM trial and searches each
+series with single-threaded C++ on its own CPU core, this stage:
+
+1. loads + de-reddens + normalises a chunk of DM-trial files with a host
+   thread pool (I/O and detrending overlap device compute of the
+   previous chunk — the async-dispatch analog of the reference's
+   fork-based overlap);
+2. stacks equal-length series into one HBM-resident (D, N) batch;
+3. runs every configured period range's periodogram plan over the whole
+   batch in a single vmapped program — sharded over the ``dm`` axis of a
+   device mesh when one is supplied (see riptide_tpu.parallel);
+4. runs peak detection per trial on the host (tiny next to the search).
+
+Only the peaks are kept, mirroring the reference's deliberate choice to
+move file paths in and small Peak lists out of its workers
+(riptide/pipeline/worker_pool.py:47-71).
+"""
+import logging
+from collections import defaultdict
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..ffautils import generate_width_trials
+from ..peak_detection import find_peaks
+from ..periodogram import Periodogram
+from ..search import periodogram_plan
+from ..search.engine import run_periodogram_batch
+from ..time_series import TimeSeries
+
+log = logging.getLogger("riptide_tpu.pipeline.batcher")
+
+__all__ = ["BatchSearcher"]
+
+
+class BatchSearcher:
+    """
+    Parameters
+    ----------
+    deredden_params : dict with keys rmed_width, rmed_minpts
+    range_confs : list of dicts
+        The 'ranges' section of the pipeline config.
+    fmt : str
+        Input file format ('presto' or 'sigproc').
+    io_threads : int
+        Host threads used to load + detrend input files.
+    mesh : jax.sharding.Mesh or None
+        When given, the DM batch is sharded over the mesh's 'dm' axis;
+        otherwise the whole batch runs on the default device.
+    """
+
+    TIMESERIES_LOADERS = {
+        "presto": TimeSeries.from_presto_inf,
+        "sigproc": TimeSeries.from_sigproc,
+    }
+
+    def __init__(self, deredden_params, range_confs, fmt="presto",
+                 io_threads=4, mesh=None, batch_size=None):
+        self.deredden_params = deredden_params
+        self.range_confs = range_confs
+        self.loader = self.TIMESERIES_LOADERS[fmt]
+        self.io_threads = int(io_threads)
+        self.mesh = mesh
+        # When set, device batches are zero-padded up to this size so a
+        # ragged final chunk reuses the compiled D-specialised programs
+        # instead of forcing a recompile (padded trials are discarded).
+        self.batch_size = batch_size
+
+    # -- host side ----------------------------------------------------------
+
+    def load_prepared(self, fname):
+        """Load one file, de-redden then normalise (once, shared by all
+        search ranges — riptide/pipeline/worker_pool.py:54-58)."""
+        ts = self.loader(fname)
+        ts = ts.deredden(
+            self.deredden_params["rmed_width"],
+            minpts=self.deredden_params["rmed_minpts"],
+        )
+        return ts.normalise()
+
+    # -- one chunk ----------------------------------------------------------
+
+    def process_fname_list(self, fnames):
+        """Search a chunk of DM-trial files; returns a flat list of Peaks."""
+        with ThreadPoolExecutor(max_workers=self.io_threads) as ex:
+            tslist = list(ex.map(self.load_prepared, fnames))
+
+        # Batch programs need equal-shape inputs: group by (nsamp, tsamp).
+        # In practice all DM trials of one observation are identical.
+        groups = defaultdict(list)
+        for ts in tslist:
+            groups[(ts.nsamp, round(ts.tsamp, 12))].append(ts)
+
+        allpeaks = []
+        for (nsamp, _), members in groups.items():
+            batch = np.stack([ts.data for ts in members])
+            if self.batch_size and len(members) < self.batch_size:
+                pad = self.batch_size - len(members)
+                batch = np.concatenate(
+                    [batch, np.zeros((pad, nsamp), np.float32)]
+                )
+            for conf in self.range_confs:
+                allpeaks.extend(self._search_range(conf, members, batch))
+        log.debug(f"Chunk of {len(fnames)} files done, peaks: {len(allpeaks)}")
+        return allpeaks
+
+    def _search_range(self, conf, members, batch):
+        kw = conf["ffa_search"]
+        widths = generate_width_trials(
+            kw["bins_min"],
+            ducy_max=kw.get("ducy_max", 0.20),
+            wtsp=kw.get("wtsp", 1.5),
+        )
+        plan = periodogram_plan(
+            batch.shape[1],
+            members[0].tsamp,
+            tuple(int(w) for w in widths),
+            float(kw["period_min"]),
+            float(kw["period_max"]),
+            int(kw["bins_min"]),
+            int(kw["bins_max"]),
+        )
+        if self.mesh is not None:
+            from ..parallel import run_periodogram_sharded
+
+            periods, foldbins, snrs = run_periodogram_sharded(
+                plan, batch, mesh=self.mesh
+            )
+        else:
+            periods, foldbins, snrs = run_periodogram_batch(plan, batch)
+
+        peaks = []
+        fp_kwargs = conf.get("find_peaks", {})
+        for d, ts in enumerate(members):
+            pgram = Periodogram(
+                np.asarray(widths), periods, foldbins, snrs[d],
+                metadata=ts.metadata,
+            )
+            found, _polycos = find_peaks(pgram, **fp_kwargs)
+            peaks.extend(found)
+        return peaks

@@ -1,0 +1,69 @@
+"""
+Clusters of Peak objects found at the same frequency across DM trials.
+
+Same role and dataframe contract as the reference's PeakCluster
+(riptide/pipeline/peak_cluster.py:4-85).
+"""
+import pandas
+
+__all__ = ["PeakCluster", "clusters_to_dataframe"]
+
+
+class PeakCluster(list):
+    """
+    A cluster of Peak objects (a list subclass), annotated with its
+    search-wide rank, and — after harmonic flagging — an optional parent
+    fundamental cluster and harmonic fraction.
+    """
+
+    def __init__(self, peaks, rank=None, parent_fundamental=None, hfrac=None):
+        super().__init__(peaks)
+        self.rank = rank
+        self.parent_fundamental = parent_fundamental
+        self.hfrac = hfrac
+
+    @property
+    def is_harmonic(self):
+        return self.parent_fundamental is not None
+
+    @property
+    def centre(self):
+        """Member peak with the highest S/N."""
+        return max(self, key=lambda peak: peak.snr)
+
+    def summary_dataframe(self):
+        """Per-member-peak parameter DataFrame."""
+        return pandas.DataFrame.from_dict([p.summary_dict() for p in self])
+
+    def summary_dict(self):
+        """One summary row: centre params + cluster size + harmonic info.
+        Absent harmonic info encodes as 0 / own rank rather than None so
+        the pandas columns stay integer-typed."""
+        return {
+            **self.centre.summary_dict(),
+            "npeaks": len(self),
+            "rank": self.rank,
+            "hfrac_num": self.hfrac.numerator if self.is_harmonic else 0,
+            "hfrac_denom": self.hfrac.denominator if self.is_harmonic else 0,
+            "fundamental_rank": (
+                self.parent_fundamental.rank if self.is_harmonic else self.rank
+            ),
+        }
+
+    def __str__(self):
+        return f"{type(self).__name__}(size={len(self)}, centre={self.centre})"
+
+    def __repr__(self):
+        return str(self)
+
+
+def clusters_to_dataframe(clusters):
+    """Summary DataFrame of all clusters, sorted by decreasing S/N, with
+    the reference's fixed column order."""
+    clusters = sorted(clusters, key=lambda c: c.centre.snr, reverse=True)
+    df = pandas.DataFrame.from_dict([cl.summary_dict() for cl in clusters])
+    columns = [
+        "rank", "period", "dm", "snr", "ducy", "freq", "npeaks",
+        "hfrac_num", "hfrac_denom", "fundamental_rank",
+    ]
+    return df[columns]

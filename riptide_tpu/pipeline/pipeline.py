@@ -1,0 +1,420 @@
+"""
+End-to-end multi-DM FFA search pipeline (the ``rffa`` application).
+
+Stage structure mirrors the reference (riptide/pipeline/pipeline.py:56-394):
+prepare -> search -> cluster_peaks -> flag_harmonics ->
+apply_candidate_filters -> build_candidates -> save_products, driven by a
+validated YAML config. The search stage is where the architecture
+diverges: instead of a multiprocessing pool of single-CPU workers, DM
+trials are batched onto the accelerator through
+:class:`riptide_tpu.pipeline.batcher.BatchSearcher` (optionally sharded
+over a device mesh); everything downstream of the periodogram — peak
+clustering, harmonic flagging, candidate building — operates on tiny
+host-side peak lists exactly as in the reference.
+"""
+import argparse
+import itertools
+import logging
+import os
+import traceback
+from collections import defaultdict
+from concurrent.futures import ThreadPoolExecutor
+
+import json
+import numpy as np
+import pandas
+import yaml
+
+from .. import __version__
+from ..candidate import Candidate
+from ..clustering import cluster1d
+from ..serialization import save_json
+from ..timing import timing
+from .batcher import BatchSearcher
+from .config_validation import validate_pipeline_config, validate_ranges
+from .dmiter import DMIterator
+from .harmonic_testing import htest
+from .peak_cluster import PeakCluster, clusters_to_dataframe
+
+log = logging.getLogger("riptide_tpu.pipeline")
+
+__all__ = ["Pipeline", "CandidateWriter", "get_parser", "run_program", "main"]
+
+
+class CandidateWriter:
+    """Writes one (rank, Candidate) to JSON (+ optional PNG); used with a
+    multiprocessing pool so plot rendering parallelises across cores."""
+
+    def __init__(self, outdir, plot=False):
+        self.outdir = os.path.realpath(outdir)
+        self.plot = plot
+
+    def __call__(self, arg):
+        rank, cand = arg
+        fname = os.path.join(self.outdir, f"candidate_{rank:04d}.json")
+        log.debug(f"Saving to {fname}: {cand}")
+        save_json(fname, cand)
+        if self.plot:
+            fname = os.path.join(self.outdir, f"candidate_{rank:04d}.png")
+            log.debug(f"Saving plot to {fname}")
+            cand.savefig(fname)
+
+
+class Pipeline:
+    """
+    Top-level multi-DM-trial search.
+
+    Parameters
+    ----------
+    config : dict
+        Configuration dictionary loaded from a YAML file (see
+        riptide_tpu/pipeline/config/example.yaml). Format is validated
+        immediately; value checks against the data happen in prepare().
+    mesh : jax.sharding.Mesh or None
+        Optional device mesh; when given, the DM batch of each search
+        chunk is sharded over its 'dm' axis.
+    """
+
+    def __init__(self, config, mesh=None):
+        self.config = validate_pipeline_config(config)
+        self.mesh = mesh
+        self.dmiter = None
+        self.searcher = None
+        self.peaks = []
+        self.clusters = []
+        self.clusters_filtered = []
+        self.candidates = []
+
+    # -- config helpers -----------------------------------------------------
+
+    def wmin(self):
+        """Minimum pulse width searched across all ranges."""
+        return min(
+            rg["ffa_search"]["period_min"] / rg["ffa_search"]["bins_min"]
+            for rg in self.config["ranges"]
+        )
+
+    def get_search_range(self, period):
+        """Search-range config dict whose period span contains ``period``
+        (used to pick candidate fold bins/subints)."""
+        ranges = sorted(
+            self.config["ranges"], key=lambda r: r["ffa_search"]["period_max"]
+        )
+        pmin_global = min(r["ffa_search"]["period_min"] for r in ranges)
+        pmax_global = max(r["ffa_search"]["period_max"] for r in ranges)
+
+        if period < pmin_global:
+            log.warning(
+                f"Given period={period:.9f} is shorter than the minimum search "
+                f"period={pmin_global:.9f}; using the shortest-period range."
+            )
+            return dict(ranges[0])
+        # Trials slightly above pmax_global legitimately occur (the cascade
+        # searches a little past period_max).
+        if period >= pmax_global:
+            return dict(ranges[-1])
+        for rg in ranges:
+            if rg["ffa_search"]["period_min"] <= period < rg["ffa_search"]["period_max"]:
+                return dict(rg)
+
+    # -- stages -------------------------------------------------------------
+
+    @timing
+    def prepare(self, files):
+        """Inspect input files, select the minimal DM-trial subset, check
+        the config against the data, and build the batch searcher."""
+        log.info(f"Preparing pipeline; input files: {len(files)}")
+        conf = self.config
+        self.dmiter = DMIterator(
+            files,
+            conf["dmselect"]["min"],
+            conf["dmselect"]["max"],
+            dmsinb_max=conf["dmselect"]["dmsinb_max"],
+            fmt=conf["data"]["format"],
+            wmin=self.wmin(),
+            fmin=conf["data"]["fmin"],
+            fmax=conf["data"]["fmax"],
+            nchans=conf["data"]["nchans"],
+        )
+        tsamp_max = self.dmiter.tsamp_max()
+        log.info(f"Max sampling time = {tsamp_max:.6e} s; validating ranges")
+        validate_ranges(conf["ranges"], tsamp_max)
+
+        self.searcher = BatchSearcher(
+            conf["dereddening"],
+            conf["ranges"],
+            fmt=conf["data"]["format"],
+            io_threads=conf["processes"],
+            mesh=self.mesh,
+            batch_size=conf["processes"],
+        )
+        log.info("Pipeline ready")
+
+    @timing
+    def search(self):
+        """Search all selected DM trials in device-sized batches. The
+        config's 'processes' value sets the DM batch size per program (it
+        is a host I/O thread count here, not a worker process count)."""
+        log.info("Running search")
+        batch = max(self.config["processes"], 1)
+        peaks = []
+        for fnames in self.dmiter.iterate_filenames(chunksize=batch):
+            peaks.extend(self.searcher.process_fname_list(fnames))
+        self.peaks = sorted(peaks, key=lambda p: p.period)
+        log.info(f"Total peaks found: {len(peaks)}")
+
+    @timing
+    def cluster_peaks(self):
+        """Friends-of-friends clustering of peak frequencies with radius
+        (config radius) / median Tobs."""
+        if not self.peaks:
+            log.info("No peaks found: skipping clustering")
+            return
+        tmed = self.dmiter.tobs_median()
+        clrad = self.config["clustering"]["radius"] / tmed
+        log.debug(f"Median Tobs = {tmed:.2f} s, clustering radius = {clrad:.3e} Hz")
+        # self.peaks is sorted by period hence by 1/freq; cluster1d sorts
+        # internally anyway.
+        freqs = np.asarray([p.freq for p in self.peaks])
+        self.clusters = [
+            PeakCluster(self.peaks[i] for i in ids)
+            for ids in cluster1d(freqs, clrad)
+        ]
+        log.info(f"Total clusters found: {len(self.clusters)}")
+
+    @timing
+    def flag_harmonics(self):
+        """Rank clusters by S/N and flag harmonically-related pairs; the
+        brighter member of each related pair becomes the fundamental."""
+        if not self.clusters:
+            log.info("No clusters found: skipping harmonic flagging")
+            return
+        tobs = self.dmiter.tobs_median()
+        fmin, fmax = self.dmiter.fmin, self.dmiter.fmax
+        kwargs = self.config["harmonic_flagging"]
+
+        by_snr = sorted(self.clusters, key=lambda c: c.centre.snr, reverse=True)
+        for rank, cl in enumerate(by_snr):
+            cl.rank = rank
+
+        for F, H in itertools.combinations(by_snr, 2):
+            if F.is_harmonic or H.is_harmonic:
+                continue
+            related, fraction = htest(F.centre, H.centre, tobs, fmin, fmax, **kwargs)
+            if related:
+                H.parent_fundamental = F
+                H.hfrac = fraction
+
+        nharm = sum(1 for c in self.clusters if c.is_harmonic)
+        log.info(f"Harmonics flagged: {nharm}")
+        log.info(f"Fundamental clusters: {len(self.clusters) - nharm}")
+
+    @timing
+    def apply_candidate_filters(self):
+        """dm_min -> snr_min -> remove_harmonics -> max_number, in that
+        order (riptide/pipeline/pipeline.py:251-289)."""
+        log.info("Applying candidate filters")
+        params = self.config["candidate_filters"]
+        kept = self.clusters
+
+        dm_min = params["dm_min"]
+        if dm_min is not None:
+            log.warning(f"Applying DM threshold of {dm_min}")
+            kept = [c for c in kept if c.centre.dm >= dm_min]
+
+        snr_min = params["snr_min"]
+        if snr_min is not None:
+            log.warning(f"Applying S/N threshold of {snr_min}")
+            kept = [c for c in kept if c.centre.snr >= snr_min]
+
+        if params["remove_harmonics"]:
+            log.warning(
+                "Harmonic removal enabled: flagged clusters will NOT become candidates"
+            )
+            kept = [c for c in kept if not c.is_harmonic]
+
+        nmax = params["max_number"]
+        if nmax:
+            if len(kept) > nmax:
+                log.warning(
+                    f"Cluster count ({len(kept)}) exceeds max_number ({nmax}); "
+                    f"the faintest {len(kept) - nmax} will not be saved"
+                )
+            kept = sorted(kept, key=lambda c: c.centre.snr, reverse=True)[:nmax]
+
+        self.clusters_filtered = kept
+        log.info(f"Clusters remaining: {len(kept)}")
+
+    @timing
+    def build_candidates(self):
+        """Fold the best-DM TimeSeries of each surviving cluster into a
+        Candidate. Clusters are grouped by DM so each file is loaded and
+        detrended once; each candidate is built under try/except so one
+        failure cannot lose the run (riptide/pipeline/pipeline.py:292-333)."""
+        log.info("Building candidates")
+        by_snr = sorted(
+            self.clusters_filtered, key=lambda c: c.centre.snr, reverse=True
+        )
+        if not by_snr:
+            log.info("No clusters: no candidates to build")
+            return
+
+        grouped = defaultdict(list)
+        for cl in by_snr:
+            grouped[cl.centre.dm].append(cl)
+        log.debug(f"{len(by_snr)} candidates to build from {len(grouped)} TimeSeries")
+
+        for dm, clusters in grouped.items():
+            ts = self.searcher.load_prepared(self.dmiter.get_filename(dm))
+            for cl in clusters:
+                try:
+                    rng = self.get_search_range(cl.centre.period)
+                    cand = Candidate.from_pipeline_output(
+                        ts, cl,
+                        rng["candidates"]["bins"],
+                        subints=rng["candidates"]["subints"],
+                    )
+                    self.candidates.append(cand)
+                except Exception as err:
+                    log.error(err)
+                    log.error(traceback.format_exc())
+
+        self.candidates = sorted(
+            self.candidates, key=lambda c: c.params["snr"], reverse=True
+        )
+        log.info(f"Total candidates: {len(self.candidates)}")
+
+    @timing
+    def save_products(self, outdir=None):
+        """peaks.csv, clusters.csv, candidates.csv + per-candidate JSON
+        (and optional PNG) written by a process pool."""
+        outdir = outdir or os.getcwd()
+        if not self.peaks:
+            log.info("No peaks found: no data products to save")
+            return
+
+        df_peaks = pandas.DataFrame.from_dict(
+            [p.summary_dict() for p in self.peaks]
+        )
+        fname = os.path.join(outdir, "peaks.csv")
+        df_peaks.to_csv(fname, sep=",", index=False, float_format="%.9f")
+        log.info(f"Saved Peak data to {fname!r}")
+
+        if self.clusters:
+            fname = os.path.join(outdir, "clusters.csv")
+            clusters_to_dataframe(self.clusters).to_csv(
+                fname, sep=",", index=False, float_format="%.9f"
+            )
+            log.info(f"Saved Cluster data to {fname!r}")
+
+        if self.candidates:
+            fname = os.path.join(outdir, "candidates.csv")
+            pandas.DataFrame.from_dict(
+                [c.params for c in self.candidates]
+            ).to_csv(fname, sep=",", index=False, float_format="%.9f")
+
+        log.info("Writing candidate files")
+        writer = CandidateWriter(outdir, plot=self.config["plot_candidates"])
+        arglist = list(enumerate(self.candidates))
+        # JSON writing parallelises over host threads (I/O bound). PNG
+        # rendering goes through matplotlib's non-thread-safe state, so
+        # plots are rendered sequentially. fork()-based process pools are
+        # off the table here: by this point the JAX/XLA runtime holds
+        # locks that a forked child would snapshot mid-held and deadlock
+        # on, and a spawned child would re-claim the TPU runtime.
+        if not self.config["plot_candidates"]:
+            with ThreadPoolExecutor(max_workers=self.config["processes"]) as ex:
+                list(ex.map(writer, arglist))
+        else:
+            for arg in arglist:
+                writer(arg)
+        log.info("Data products written")
+
+    @timing
+    def process(self, files, outdir):
+        """Run all stages. Candidate filters apply *after* harmonic
+        flagging so e.g. a bright zero-DM signal still claims its
+        harmonics before any DM cut removes it."""
+        self.prepare(files)
+        self.search()
+        self.cluster_peaks()
+        self.flag_harmonics()
+        self.apply_candidate_filters()
+        self.build_candidates()
+        self.save_products(outdir=outdir)
+
+    @classmethod
+    def from_yaml_config(cls, fname, mesh=None):
+        log.debug(f"Creating pipeline from config file: {fname}")
+        with open(fname) as fobj:
+            conf = yaml.safe_load(fobj)
+        log.debug(f"Pipeline configuration: {json.dumps(conf, indent=4)}")
+        return cls(conf, mesh=mesh)
+
+
+# ----------------------------------------------------------------------------
+# CLI (the rffa console application)
+# ----------------------------------------------------------------------------
+
+def get_parser():
+    def outdir(path):
+        if not os.path.isdir(path):
+            raise argparse.ArgumentTypeError(
+                f"Specified output directory {path!r} does not exist"
+            )
+        return path
+
+    parser = argparse.ArgumentParser(
+        formatter_class=lambda prog: argparse.ArgumentDefaultsHelpFormatter(
+            prog, max_help_position=16
+        ),
+        description="Search multiple DM trials with the riptide_tpu end-to-end FFA pipeline.",
+    )
+    parser.add_argument("-c", "--config", type=str, required=True,
+                        help="Pipeline configuration file")
+    parser.add_argument("-o", "--outdir", type=outdir, default=os.getcwd(),
+                        help="Output directory for the data products")
+    parser.add_argument("-f", "--logfile", type=str, default=None,
+                        help="Save logs to given file")
+    parser.add_argument("--log-level", type=str, default="DEBUG",
+                        choices=["DEBUG", "INFO", "WARNING"],
+                        help="Logging level for the riptide_tpu logger")
+    parser.add_argument("--log-timings", action="store_true",
+                        help="Log the execution times of all major functions")
+    parser.add_argument("--version", action="version", version=__version__)
+    parser.add_argument("files", type=str, nargs="+",
+                        help="Input file(s) of the configured format")
+    return parser
+
+
+def run_program(args):
+    # Non-interactive matplotlib backend; switched here rather than at
+    # import time so library users keep their own backend.
+    import matplotlib.pyplot as plt
+
+    plt.switch_backend("Agg")
+
+    handlers = [logging.StreamHandler()]
+    if args.logfile:
+        handlers.append(logging.FileHandler(args.logfile, mode="w"))
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(filename)18s:%(lineno)-4s %(levelname)-8s %(message)s",
+        handlers=handlers,
+    )
+    logging.getLogger("matplotlib").setLevel("WARNING")
+    logging.getLogger("riptide_tpu.timing").setLevel(
+        "DEBUG" if args.log_timings else "WARNING"
+    )
+
+    pipeline = Pipeline.from_yaml_config(args.config)
+    pipeline.process(args.files, args.outdir)
+    log.info("CALCULATIONS CORRECT")
+
+
+def main():
+    run_program(get_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

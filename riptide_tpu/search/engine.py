@@ -161,6 +161,19 @@ def run_periodogram(plan, data):
     return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs
 
 
+def prepare_batch(plan, batch):
+    """
+    Host-side preparation of a (D, N) DM-trial stack: float32 cast, shape
+    check against the plan, per-row split prefix sums. Returns device
+    arrays (x, cs_hi, cs_lo).
+    """
+    batch = np.asarray(batch, dtype=np.float32)
+    if batch.ndim != 2 or batch.shape[1] != plan.size:
+        raise ValueError("batch must be (D, N) with N matching the plan")
+    his, los = zip(*(split_prefix_sums(row) for row in batch))
+    return jnp.asarray(batch), jnp.asarray(np.stack(his)), jnp.asarray(np.stack(los))
+
+
 def run_periodogram_batch(plan, batch):
     """
     Execute the plan over a (D, N) stack of normalised series (one per DM
@@ -168,13 +181,7 @@ def run_periodogram_batch(plan, batch):
 
     Returns (periods, foldbins, snrs (D, len, NW)).
     """
-    batch = np.asarray(batch, dtype=np.float32)
-    if batch.ndim != 2 or batch.shape[1] != plan.size:
-        raise ValueError("batch must be (D, N) with N matching the plan")
-    his, los = zip(*(split_prefix_sums(row) for row in batch))
-    x = jnp.asarray(batch)
-    cs_hi = jnp.asarray(np.stack(his))
-    cs_lo = jnp.asarray(np.stack(los))
+    x, cs_hi, cs_lo = prepare_batch(plan, batch)
     outs = []
     for st in plan.stages:
         ops = _stage_operands(st)
@@ -187,6 +194,6 @@ def run_periodogram_batch(plan, batch):
         )
     raw = [np.asarray(o) for o in outs]  # (D, B, R, NW) each
     snrs = np.stack(
-        [_assemble(plan, [r[d] for r in raw]) for d in range(batch.shape[0])]
+        [_assemble(plan, [r[d] for r in raw]) for d in range(x.shape[0])]
     )
     return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs

@@ -2,17 +2,30 @@
 Test configuration: force the CPU backend with 8 virtual devices so
 sharding/multi-chip code paths are exercised without TPU hardware, and
 keep everything deterministic.
+
+NOTE on the axon environment: the image's sitecustomize imports jax at
+interpreter startup (to register the TPU tunnel), so environment
+variables set here are too late to influence jax's import-time config
+reads. ``jax.config.update`` works post-import as long as no backend has
+been initialised yet, which is the case at conftest import time.
 """
 import os
 
-# Force, don't setdefault: the environment ships with JAX_PLATFORMS=axon
-# (the TPU tunnel) and the single TPU chip must not be contended by tests.
+# Effective when jax was NOT pre-imported by sitecustomize (e.g. running
+# with PALLAS_AXON_POOL_IPS unset); harmless otherwise.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
+    # Read at CPU backend initialisation, which has not happened yet.
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 # Persistent compilation cache: kernel shapes repeat across test runs.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/riptide_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+import jax  # noqa: E402
+
+# Effective even when sitecustomize already imported jax with
+# JAX_PLATFORMS=axon: config updates apply until first backend use.
+jax.config.update("jax_platforms", "cpu")

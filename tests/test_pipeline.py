@@ -1,0 +1,298 @@
+"""
+End-to-end pipeline tests with deterministic synthetic data, mirroring
+the reference's strategy (riptide/tests/test_pipeline.py): three PRESTO
+DM trials (0/10/20) with the DM-10 one brightest, run through the real
+argparse entry point, asserting the top candidate's parameters; a
+pure-noise run produces no candidates; config validation failures raise
+typed errors.
+"""
+import glob
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+import yaml
+
+from riptide_tpu import load_json
+from riptide_tpu.pipeline import (
+    InvalidPipelineConfig,
+    InvalidSearchRange,
+    Pipeline,
+    get_parser,
+    hdiag,
+    htest,
+    run_program,
+    select_dms,
+    validate_pipeline_config,
+    validate_ranges,
+)
+from riptide_tpu.pipeline.peak_cluster import PeakCluster, clusters_to_dataframe
+
+from synth import generate_data_presto, write_presto
+
+HERE = os.path.dirname(__file__)
+CONFIG_A = os.path.join(HERE, "pipeline_config_A.yml")
+CONFIG_B = os.path.join(HERE, "pipeline_config_B.yml")
+
+TOBS = 128.0
+TSAMP = 256e-6
+PERIOD = 1.0
+# Amplitude per DM trial: DM 10 is the true dispersion measure
+AMPLITUDES = {0.0: 10.0, 10.0: 20.0, 20.0: 10.0}
+
+
+def make_fake_survey(outdir, amplitudes=AMPLITUDES):
+    """Write one PRESTO .inf/.dat pair per DM trial; identical seeded
+    noise, pulsar amplitude peaking at DM 10."""
+    paths = []
+    for dm, amp in amplitudes.items():
+        paths.append(
+            generate_data_presto(
+                str(outdir), f"fake_DM{dm:.2f}", tobs=TOBS, tsamp=TSAMP,
+                period=PERIOD, dm=dm, amplitude=amp, ducy=0.02,
+            )
+        )
+    return paths
+
+
+def run_pipeline(config, files, outdir):
+    args = get_parser().parse_args(
+        ["--config", config, "--outdir", str(outdir), "--log-level", "WARNING"]
+        + [str(f) for f in files]
+    )
+    run_program(args)
+
+
+def test_pipeline_finds_fake_pulsar(tmp_path):
+    indir = tmp_path / "data"
+    outdir = tmp_path / "out"
+    indir.mkdir()
+    outdir.mkdir()
+    files = make_fake_survey(indir)
+
+    run_pipeline(CONFIG_A, files, outdir)
+
+    for product in ("peaks.csv", "clusters.csv", "candidates.csv"):
+        assert (outdir / product).exists()
+
+    cand_files = sorted(glob.glob(str(outdir / "candidate_*.json")))
+    assert cand_files, "no candidate files written"
+    cand = load_json(cand_files[0])
+
+    # The reference's deterministic oracle (riptide/tests/test_pipeline.py:64-74)
+    assert abs(cand.params["period"] - PERIOD) < 0.1 / TOBS * PERIOD**2
+    assert cand.params["dm"] == 10.0
+    assert cand.params["width"] == 13
+    assert abs(cand.params["snr"] - 18.5) < 0.15
+
+
+def test_pipeline_config_B(tmp_path):
+    """Config B: DM cap + dm_min filter + max_number 1 + PNG plots."""
+    indir = tmp_path / "data"
+    outdir = tmp_path / "out"
+    indir.mkdir()
+    outdir.mkdir()
+    files = make_fake_survey(indir)
+
+    run_pipeline(CONFIG_B, files, outdir)
+
+    cand_files = sorted(glob.glob(str(outdir / "candidate_*.json")))
+    assert len(cand_files) == 1  # max_number: 1
+    assert (outdir / "candidate_0000.png").exists()  # plot_candidates: True
+    cand = load_json(cand_files[0])
+    assert cand.params["dm"] == 10.0  # dm_min: 1.0 keeps only the real DM
+
+
+def test_pipeline_pure_noise(tmp_path):
+    """A pure-noise survey must produce no candidate files
+    (riptide/tests/test_pipeline.py:77-97)."""
+    indir = tmp_path / "data"
+    outdir = tmp_path / "out"
+    indir.mkdir()
+    outdir.mkdir()
+    files = make_fake_survey(indir, amplitudes={0.0: 0.0, 10.0: 0.0, 20.0: 0.0})
+
+    run_pipeline(CONFIG_A, files, outdir)
+    assert not glob.glob(str(outdir / "candidate_*.json"))
+    assert not glob.glob(str(outdir / "candidate_*.png"))
+
+
+# ----------------------------------------------------------------------------
+# Config validation
+# ----------------------------------------------------------------------------
+
+def load_config(path):
+    with open(path) as fobj:
+        return yaml.safe_load(fobj)
+
+
+def test_example_config_validates():
+    example = os.path.join(
+        os.path.dirname(HERE), "riptide_tpu", "pipeline", "config", "example.yaml"
+    )
+    conf = validate_pipeline_config(load_config(example))
+    assert conf["processes"] == 4
+    assert len(conf["ranges"]) == 3
+    validate_ranges(conf["ranges"], 64e-6)
+
+
+def test_config_validation_errors():
+    conf = load_config(CONFIG_A)
+
+    bad = json.loads(json.dumps(conf))
+    bad["processes"] = -1
+    with pytest.raises(InvalidPipelineConfig):
+        validate_pipeline_config(bad)
+
+    bad = json.loads(json.dumps(conf))
+    bad["data"]["format"] = "hdf5"
+    with pytest.raises(InvalidPipelineConfig):
+        validate_pipeline_config(bad)
+
+    bad = json.loads(json.dumps(conf))
+    del bad["clustering"]
+    with pytest.raises(InvalidPipelineConfig):
+        validate_pipeline_config(bad)
+
+    bad = json.loads(json.dumps(conf))
+    bad["ranges"][0]["ffa_search"]["wtsp"] = 0.5
+    with pytest.raises(InvalidPipelineConfig):
+        validate_pipeline_config(bad)
+
+    bad = json.loads(json.dumps(conf))
+    bad["unknown_section"] = {}
+    with pytest.raises(InvalidPipelineConfig):
+        validate_pipeline_config(bad)
+
+
+def test_range_validation_against_data():
+    conf = validate_pipeline_config(load_config(CONFIG_A))
+    # bins_min * tsamp_max > period_min -> invalid
+    with pytest.raises(InvalidSearchRange):
+        validate_ranges(conf["ranges"], tsamp_max=0.5 / 480 * 1.01)
+    # candidate bins unfoldable at this resolution
+    conf["ranges"][0]["candidates"]["bins"] = 4096
+    with pytest.raises(InvalidSearchRange):
+        validate_ranges(conf["ranges"], tsamp_max=256e-6 * 8)
+
+
+def test_ranges_contiguity():
+    conf = validate_pipeline_config(load_config(CONFIG_A))
+    rg2 = json.loads(json.dumps(conf["ranges"][0]))
+    rg2["ffa_search"]["period_min"] = 3.0  # gap: 2.0 != 3.0
+    rg2["ffa_search"]["period_max"] = 4.0
+    with pytest.raises(InvalidSearchRange):
+        validate_ranges(conf["ranges"] + [rg2], tsamp_max=256e-6)
+
+
+# ----------------------------------------------------------------------------
+# DM selection
+# ----------------------------------------------------------------------------
+
+BAND = dict(fmin=1182.0, fmax=1582.0, nchans=1024)
+
+
+def test_select_dms_covers_range():
+    trials = np.arange(0.0, 100.5, 0.05)
+    sel = select_dms(trials, 0.0, 100.0, wmin=1.0e-3, **BAND)
+    assert sel[0] == 0.0
+    assert sel[-1] >= 99.0
+    assert np.all(np.diff(sel) > 0)
+    # far fewer trials than available, but never a coverage gap:
+    # consecutive selected trials' radii must touch
+    kdisp = (1.0 / 2.41e-4) * (BAND["fmin"] ** -2 - BAND["fmax"] ** -2)
+    cw = (BAND["fmax"] - BAND["fmin"]) / BAND["nchans"]
+    fmid = (BAND["fmax"] + BAND["fmin"]) / 2
+    ksmear = (1.0 / 2.41e-4) * ((fmid - cw / 2) ** -2 - (fmid + cw / 2) ** -2)
+    radii = np.maximum(1.0e-3, ksmear * sel) / kdisp
+    gaps = (sel[1:] - radii[1:]) - (sel[:-1] + radii[:-1])
+    assert np.all(gaps <= 1e-9)
+    assert len(sel) < len(trials) / 2
+
+
+def test_select_dms_empty_range():
+    with pytest.raises(ValueError):
+        select_dms([1.0, 2.0], 5.0, 10.0, wmin=1e-3, **BAND)
+
+
+# ----------------------------------------------------------------------------
+# Harmonic testing
+# ----------------------------------------------------------------------------
+
+def _cand(freq, snr, ducy=0.05, dm=10.0):
+    return types.SimpleNamespace(freq=freq, snr=snr, ducy=ducy, dm=dm)
+
+
+def test_htest_flags_true_harmonic():
+    F = _cand(1.0, 20.0)
+    H = _cand(2.0, 20.0 / np.sqrt(2.0))
+    related, fraction = htest(F, H, tobs=128.0, fmin=1182.0, fmax=1582.0)
+    assert related
+    assert (fraction.numerator, fraction.denominator) == (2, 1)
+
+
+def test_htest_rejects_unrelated():
+    F = _cand(1.0, 20.0)
+    # A bright signal at an irrational-ish frequency ratio: the closest
+    # p/q has a large p*q, so the expected harmonic S/N is tiny and the
+    # S/N distance test fails (and the phase drift is over one width).
+    H = _cand(1.3719, 15.0)
+    related, _ = htest(F, H, tobs=128.0, fmin=1182.0, fmax=1582.0)
+    assert not related
+
+
+def test_htest_rejects_wrong_dm():
+    F = _cand(1.0, 20.0, dm=10.0)
+    H = _cand(2.0, 20.0 / np.sqrt(2.0), dm=300.0)
+    related, _ = htest(F, H, tobs=128.0, fmin=1182.0, fmax=1582.0)
+    assert not related
+
+
+def test_hdiag_values():
+    F = _cand(1.0, 20.0)
+    H = _cand(2.0, 20.0 / np.sqrt(2.0))
+    d = hdiag(F, H, tobs=128.0, fmin=1182.0, fmax=1582.0)
+    assert d["fraction"] == 2
+    assert d["phase_absdiff_turns"] == pytest.approx(0.0, abs=1e-9)
+    assert d["dm_absdiff"] == 0.0
+    assert d["snr_distance"] == pytest.approx(0.0, abs=1e-9)
+
+
+# ----------------------------------------------------------------------------
+# PeakCluster
+# ----------------------------------------------------------------------------
+
+def _peak(freq, snr, dm=0.0):
+    from riptide_tpu.peak_detection import Peak
+
+    return Peak(
+        period=1.0 / freq, freq=freq, width=13, ducy=0.025,
+        iw=0, ip=0, snr=snr, dm=dm,
+    )
+
+
+def test_peak_cluster_and_dataframe():
+    a = PeakCluster([_peak(1.0, 10.0), _peak(1.0001, 15.0)])
+    b = PeakCluster([_peak(2.0, 8.0)])
+    a.rank, b.rank = 0, 1
+    assert a.centre.snr == 15.0
+    assert not a.is_harmonic
+
+    from fractions import Fraction
+
+    b.parent_fundamental = a
+    b.hfrac = Fraction(2, 1)
+    assert b.is_harmonic
+
+    df = clusters_to_dataframe([a, b])
+    assert list(df.columns) == [
+        "rank", "period", "dm", "snr", "ducy", "freq", "npeaks",
+        "hfrac_num", "hfrac_denom", "fundamental_rank",
+    ]
+    # sorted by decreasing S/N: cluster a first
+    assert df.iloc[0]["snr"] == 15.0
+    assert df.iloc[1]["hfrac_num"] == 2
+    assert df.iloc[1]["fundamental_rank"] == 0
+    assert df.iloc[0]["fundamental_rank"] == 0  # fundamental points at itself

@@ -1,0 +1,43 @@
+// Standalone timing harness for the reference C++ periodogram engine.
+// Includes the read-only reference headers; used only to measure the
+// single-core CPU baseline that bench.py compares against.
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <vector>
+
+#include "periodogram.hpp"
+
+int main(int argc, char** argv) {
+    size_t n = argc > 1 ? strtoul(argv[1], nullptr, 10) : (1UL << 23);
+    int loops = argc > 2 ? atoi(argv[2]) : 3;
+    double tsamp = 64e-6, pmin = 0.5, pmax = 3.0;
+    size_t bmin = 240, bmax = 260;
+    std::vector<size_t> widths = {1, 2, 3, 4, 6, 9, 13, 19, 28, 42};
+
+    std::mt19937 rng(0);
+    std::normal_distribution<float> gauss(0.0f, 1.0f);
+    std::vector<float> data(n);
+    for (auto& x : data) x = gauss(rng);
+
+    size_t len = riptide::periodogram_length(n, tsamp, pmin, pmax, bmin, bmax);
+    std::vector<double> periods(len);
+    std::vector<uint32_t> foldbins(len);
+    std::vector<float> snr(len * widths.size());
+
+    double best = 1e30;
+    for (int i = 0; i < loops; ++i) {
+        auto t0 = std::chrono::steady_clock::now();
+        riptide::periodogram(data.data(), n, tsamp, widths.data(), widths.size(),
+                             pmin, pmax, bmin, bmax,
+                             periods.data(), foldbins.data(), snr.data());
+        auto t1 = std::chrono::steady_clock::now();
+        double dt = std::chrono::duration<double>(t1 - t0).count();
+        if (dt < best) best = dt;
+        fprintf(stderr, "loop %d: %.3f s\n", i, dt);
+    }
+    printf("{\"n\": %zu, \"trials\": %zu, \"seconds_per_dm_trial\": %.4f}\n",
+           n, len, best);
+    return 0;
+}
