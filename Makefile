@@ -1,0 +1,31 @@
+# Convenience targets mirroring the reference's Makefile surface.
+
+PYTHON ?= python
+
+.PHONY: install tests tests-cov native bench clean
+
+install:
+	$(PYTHON) -m pip install -e .
+
+# Run the test suite on the CPU backend (8 virtual devices). PYTHONPATH is
+# cleared so the axon TPU site customization does not claim the device for
+# a CPU-only run.
+tests:
+	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q
+
+tests-cov:
+	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q \
+		--cov=riptide_tpu --cov-report=term
+
+# Build the native host library explicitly (it otherwise builds lazily
+# on first use).
+native:
+	$(PYTHON) -c "from riptide_tpu import native; assert native.available()"
+
+# Headline benchmark on the default device (ONE JSON line).
+bench:
+	$(PYTHON) bench.py
+
+clean:
+	rm -rf riptide_tpu/native/_build build dist *.egg-info
+	find . -name __pycache__ -type d -exec rm -rf {} +

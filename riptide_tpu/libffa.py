@@ -20,15 +20,51 @@ __all__ = [
     "downsample",
     "generate_signal",
     "generate_width_trials",
+    "benchmark_ffa2",
 ]
+
+
+def benchmark_ffa2(rows, cols, loops=10):
+    """
+    Best wall-clock seconds per (rows, cols) FFA transform on the default
+    JAX device (the analog of the reference's ``libcpp.benchmark_ffa2``,
+    riptide/cpp/python_bindings.cpp:87-106; the CPU-native counterpart is
+    :func:`riptide_tpu.native.benchmark_ffa`).
+    """
+    import time
+
+    import jax.numpy as jnp
+
+    from .ops.ffa import _ffa2_padded
+
+    rows, cols = int(rows), int(cols)
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((rows, cols)), jnp.float32
+    )
+    _ffa2_padded(x, rows, cols).block_until_ready()  # compile
+    best = float("inf")
+    for _ in range(int(loops)):
+        t0 = time.perf_counter()
+        _ffa2_padded(x, rows, cols).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return best
 
 
 def downsample(data, factor):
     """
     Downsample an array by a real-valued factor (fractional boundary
-    samples split by linear weights). Host-side float64 path; the search
-    engine uses the on-device gather formulation internally.
+    samples split by linear weights). Host-side float64 path (native C++
+    when available); the search engine uses the on-device gather
+    formulation internally.
     """
+    from . import native
+
+    data = np.asarray(data, dtype=np.float32)
+    n = data.size
+    if not (factor > 1.0 and factor <= n):
+        raise ValueError("Downsampling factor must verify: 1 < f <= size")
+    if native.available():
+        return native.downsample(data, factor)
     return _ref.downsample(data, factor)
 
 

@@ -1,0 +1,219 @@
+"""
+ctypes binding of the native host runtime (see src/riptide_native.cpp).
+
+The shared library is built on first use with g++ (no pybind11 in this
+environment) and cached next to the package; ``available()`` reports
+whether the toolchain/build worked, and every consumer falls back to
+numpy when it did not.
+"""
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+from numpy.ctypeslib import ndpointer
+
+log = logging.getLogger("riptide_tpu.native")
+
+__all__ = [
+    "available",
+    "read_f32",
+    "decode8",
+    "ffa_tables",
+    "ffa_transform",
+    "benchmark_ffa",
+    "running_median",
+    "downsample",
+    "circular_prefix_sum",
+    "boxcar_snr",
+]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "riptide_native.cpp")
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libriptide_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _f32(flags="C"):
+    return ndpointer(np.float32, flags=flags)
+
+
+def _build():
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+        _SRC, "-o", _LIB_PATH,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _bind(lib):
+    c64 = ctypes.c_int64
+    lib.rn_read_f32.restype = c64
+    lib.rn_read_f32.argtypes = [ctypes.c_char_p, c64, c64, _f32("C_CONTIGUOUS")]
+    lib.rn_decode8.restype = None
+    lib.rn_decode8.argtypes = [
+        ctypes.c_void_p, c64, ctypes.c_int, _f32("C_CONTIGUOUS"),
+    ]
+    i32p = ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.rn_ffa_tables.restype = None
+    lib.rn_ffa_tables.argtypes = [c64, c64, i32p, i32p, i32p]
+    lib.rn_ffa_transform.restype = None
+    lib.rn_ffa_transform.argtypes = [
+        _f32("C_CONTIGUOUS"), c64, c64, _f32("C_CONTIGUOUS"),
+    ]
+    lib.rn_benchmark_ffa.restype = ctypes.c_double
+    lib.rn_benchmark_ffa.argtypes = [c64, c64, c64]
+    lib.rn_running_median.restype = None
+    lib.rn_running_median.argtypes = [
+        _f32("C_CONTIGUOUS"), c64, c64, _f32("C_CONTIGUOUS"),
+    ]
+    lib.rn_downsample.restype = None
+    lib.rn_downsample.argtypes = [
+        _f32("C_CONTIGUOUS"), c64, ctypes.c_double, _f32("C_CONTIGUOUS"),
+    ]
+    f64p = ndpointer(np.float64, flags="C_CONTIGUOUS")
+    lib.rn_circular_prefix_sum.restype = None
+    lib.rn_circular_prefix_sum.argtypes = [_f32("C_CONTIGUOUS"), c64, c64, f64p]
+    i64p = ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.rn_boxcar_snr.restype = None
+    lib.rn_boxcar_snr.argtypes = [
+        _f32("C_CONTIGUOUS"), c64, c64, i64p, c64, ctypes.c_float,
+        _f32("C_CONTIGUOUS"),
+    ]
+    return lib
+
+
+def _get():
+    """The bound library, building it on first call; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            stale = (
+                not os.path.exists(_LIB_PATH)
+                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+            )
+            if stale:
+                _build()
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except Exception as err:
+            log.warning(f"native library unavailable ({err}); using numpy fallbacks")
+            _lib = None
+    return _lib
+
+
+def available():
+    """True when the native shared library built and loaded."""
+    return _get() is not None
+
+
+# ---------------------------------------------------------------------------
+# Wrappers (callers must check available() or handle RuntimeError)
+# ---------------------------------------------------------------------------
+
+def _require():
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("riptide_tpu native library is not available")
+    return lib
+
+
+def read_f32(path, offset, count):
+    """Read ``count`` float32 samples at byte ``offset`` of ``path``.
+    Raises OSError on open failure or short read."""
+    lib = _require()
+    out = np.empty(count, np.float32)
+    got = lib.rn_read_f32(os.fsencode(path), int(offset), int(count), out)
+    if got != count:
+        raise OSError(
+            f"expected {count} float32 samples at offset {offset} of "
+            f"{path!r}, read {got}"
+        )
+    return out
+
+
+def decode8(raw, signed):
+    """Decode a bytes-like of 8-bit samples to float32."""
+    lib = _require()
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    out = np.empty(buf.size, np.float32)
+    lib.rn_decode8(buf.ctypes.data, buf.size, int(bool(signed)), out)
+    return out
+
+
+def ffa_tables(m, L):
+    """(h, t, shift) int32 tables of shape (L, m + 1); same contract as
+    riptide_tpu.ops.plan.FFAPlan."""
+    lib = _require()
+    m, L = int(m), int(L)
+    h = np.empty((L, m + 1), np.int32)
+    t = np.empty((L, m + 1), np.int32)
+    shift = np.empty((L, m + 1), np.int32)
+    lib.rn_ffa_tables(m, L, h, t, shift)
+    return h, t, shift
+
+
+def ffa_transform(data):
+    """CPU FFA transform of an (m, p) float32 array."""
+    lib = _require()
+    data = np.ascontiguousarray(data, np.float32)
+    m, p = data.shape
+    out = np.empty_like(data)
+    lib.rn_ffa_transform(data, m, p, out)
+    return out
+
+
+def benchmark_ffa(rows, cols, loops=10):
+    """Best seconds per (rows, cols) CPU FFA transform over ``loops`` runs
+    (the native analog of the reference's libcpp.benchmark_ffa2)."""
+    return float(_require().rn_benchmark_ffa(int(rows), int(cols), int(loops)))
+
+
+def running_median(data, width):
+    """Exact edge-padded sliding median, odd ``width`` < data size."""
+    lib = _require()
+    data = np.ascontiguousarray(data, np.float32)
+    out = np.empty_like(data)
+    lib.rn_running_median(data, data.size, int(width), out)
+    return out
+
+
+def downsample(data, f):
+    """Real-factor downsample with fractional boundary weights."""
+    lib = _require()
+    data = np.ascontiguousarray(data, np.float32)
+    nout = int(np.floor(data.size / f))
+    out = np.empty(nout, np.float32)
+    lib.rn_downsample(data, data.size, float(f), out)
+    return out
+
+
+def circular_prefix_sum(data, nsum):
+    """Circularly-extended inclusive prefix sum (float64)."""
+    lib = _require()
+    data = np.ascontiguousarray(data, np.float32)
+    out = np.empty(int(nsum), np.float64)
+    lib.rn_circular_prefix_sum(data, data.size, int(nsum), out)
+    return out
+
+
+def boxcar_snr(data, widths, stdnoise=1.0):
+    """Row-wise boxcar matched-filter S/N of a (rows, bins) array."""
+    lib = _require()
+    data = np.ascontiguousarray(data, np.float32)
+    rows, bins = data.shape
+    widths = np.ascontiguousarray(widths, np.int64)
+    out = np.empty((rows, widths.size), np.float32)
+    lib.rn_boxcar_snr(data, rows, bins, widths, widths.size, float(stdnoise), out)
+    return out

@@ -1,0 +1,256 @@
+// Native host runtime for riptide_tpu.
+//
+// The TPU compute path lives in XLA/Pallas; this library provides the
+// native host-side pieces that surround it, mirroring the roles the
+// reference implements in C++ (riptide/cpp/*.hpp) without sharing its
+// structure:
+//   - bulk data loading / 8-bit decoding (the data-loader),
+//   - FFA level-table construction (the plan/graph builder used by
+//     riptide_tpu.ops.plan),
+//   - exact CPU kernels: downsample backs the host-side
+//     riptide_tpu.libffa.downsample API; running median, prefix sum,
+//     boxcar S/N and the iterative FFA transform serve as independent
+//     cross-checks of the numpy oracles in the test suite and power the
+//     rn_benchmark_ffa CPU micro-benchmark.
+//
+// All entry points are extern "C" with plain pointers, bound from
+// Python via ctypes (no pybind11 in this environment).
+
+#include <algorithm>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <set>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Data loading / decoding
+// ---------------------------------------------------------------------------
+
+// Read `count` float32 samples starting at byte `offset`. Returns the
+// number of samples actually read (0 on open failure).
+int64_t rn_read_f32(const char* path, int64_t offset, int64_t count, float* out) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return 0;
+    if (std::fseek(f, static_cast<long>(offset), SEEK_SET) != 0) {
+        std::fclose(f);
+        return 0;
+    }
+    int64_t got = static_cast<int64_t>(std::fread(out, sizeof(float), count, f));
+    std::fclose(f);
+    return got;
+}
+
+// Decode n 8-bit samples (signed or unsigned) to float32.
+void rn_decode8(const void* in, int64_t n, int is_signed, float* out) {
+    if (is_signed) {
+        const int8_t* p = static_cast<const int8_t*>(in);
+        for (int64_t i = 0; i < n; ++i) out[i] = static_cast<float>(p[i]);
+    } else {
+        const uint8_t* p = static_cast<const uint8_t*>(in);
+        for (int64_t i = 0; i < n; ++i) out[i] = static_cast<float>(p[i]);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// FFA level tables (the plan builder)
+// ---------------------------------------------------------------------------
+//
+// Semantics contract (shared with riptide_tpu/ops/plan.py): an m-row
+// transform runs as L = ceil(log2(m)) levels over an (m + 1)-row buffer
+// whose last row Z is held at zero. A node of mn rows occupying buffer
+// rows [r0, r0+mn) merges at 1-based level `lvl`; its children merge one
+// level earlier. Rows not being merged at a level carry through via the
+// identity entry out[i] = buf[i] + roll(buf[Z], 0). The merge row
+// mapping rounds kh*s + 0.5 in float32 to bit-match the float arithmetic
+// the search numerics were validated against.
+
+static void fill_node(int64_t r0, int64_t mn, int64_t lvl, int64_t m, int64_t L,
+                      int32_t* h, int32_t* t, int32_t* shift) {
+    if (mn == 1) return;
+    const int64_t R = m + 1;
+    const int64_t mh = mn / 2;
+    const int64_t mt = mn - mh;
+    fill_node(r0, mh, lvl - 1, m, L, h, t, shift);
+    fill_node(r0 + mh, mt, lvl - 1, m, L, h, t, shift);
+    const float kh = static_cast<float>(mh - 1) / static_cast<float>(mn - 1);
+    const float kt = static_cast<float>(mt - 1) / static_cast<float>(mn - 1);
+    int32_t* hl = h + (lvl - 1) * R;
+    int32_t* tl = t + (lvl - 1) * R;
+    int32_t* sl = shift + (lvl - 1) * R;
+    for (int64_t s = 0; s < mn; ++s) {
+        const int32_t hs = static_cast<int32_t>(kh * static_cast<float>(s) + 0.5f);
+        const int32_t ts = static_cast<int32_t>(kt * static_cast<float>(s) + 0.5f);
+        hl[r0 + s] = static_cast<int32_t>(r0) + hs;
+        tl[r0 + s] = static_cast<int32_t>(r0 + mh) + ts;
+        sl[r0 + s] = static_cast<int32_t>(s) - ts;
+    }
+}
+
+// Fill (L, m + 1) int32 tables h/t/shift for an m-row transform.
+// L must be >= ceil(log2(m)); extra levels stay identity.
+void rn_ffa_tables(int64_t m, int64_t L, int32_t* h, int32_t* t, int32_t* shift) {
+    const int64_t R = m + 1;
+    const int32_t Z = static_cast<int32_t>(m);
+    for (int64_t l = 0; l < L; ++l) {
+        int32_t* hl = h + l * R;
+        int32_t* tl = t + l * R;
+        int32_t* sl = shift + l * R;
+        for (int64_t i = 0; i < R; ++i) {
+            hl[i] = static_cast<int32_t>(i);
+            tl[i] = Z;
+            sl[i] = 0;
+        }
+        hl[Z] = Z;
+    }
+    int64_t levels = 0;
+    while ((int64_t(1) << levels) < m) ++levels;
+    if (levels > 0) fill_node(0, m, levels, m, L, h, t, shift);
+}
+
+// ---------------------------------------------------------------------------
+// Iterative FFA transform (CPU fallback / benchmark)
+// ---------------------------------------------------------------------------
+
+// out[s] = sum over input rows with phase drift s; (m, p) -> (m, p).
+void rn_ffa_transform(const float* in, int64_t m, int64_t p, float* out) {
+    if (m == 1) {
+        std::memcpy(out, in, sizeof(float) * p);
+        return;
+    }
+    int64_t L = 0;
+    while ((int64_t(1) << L) < m) ++L;
+    const int64_t R = m + 1;
+    std::vector<int32_t> h(L * R), t(L * R), shift(L * R);
+    rn_ffa_tables(m, L, h.data(), t.data(), shift.data());
+
+    std::vector<float> a(R * p, 0.0f), b(R * p, 0.0f);
+    std::memcpy(a.data(), in, sizeof(float) * m * p);
+    float* cur = a.data();
+    float* nxt = b.data();
+    for (int64_t l = 0; l < L; ++l) {
+        const int32_t* hl = h.data() + l * R;
+        const int32_t* tl = t.data() + l * R;
+        const int32_t* sl = shift.data() + l * R;
+        for (int64_t i = 0; i < R; ++i) {
+            const float* hr = cur + int64_t(hl[i]) * p;
+            const float* tr = cur + int64_t(tl[i]) * p;
+            float* o = nxt + i * p;
+            const int64_t sh = sl[i] % p;
+            // o = hr + roll(tr, -sh): two contiguous spans
+            for (int64_t j = 0; j < p - sh; ++j) o[j] = hr[j] + tr[j + sh];
+            for (int64_t j = p - sh; j < p; ++j) o[j] = hr[j] + tr[j + sh - p];
+        }
+        std::swap(cur, nxt);
+    }
+    std::memcpy(out, cur, sizeof(float) * m * p);
+}
+
+// Seconds per transform of an (rows, cols) random array, best timing
+// over `loops` runs (the benchmark_ffa2 analog).
+double rn_benchmark_ffa(int64_t rows, int64_t cols, int64_t loops) {
+    std::vector<float> in(rows * cols), out(rows * cols);
+    for (size_t i = 0; i < in.size(); ++i)
+        in[i] = static_cast<float>((i * 2654435761u & 0xffff) / 65536.0 - 0.5);
+    double best = 1e30;
+    for (int64_t l = 0; l < loops; ++l) {
+        auto t0 = std::chrono::steady_clock::now();
+        rn_ffa_transform(in.data(), rows, cols, out.data());
+        auto t1 = std::chrono::steady_clock::now();
+        best = std::min(best, std::chrono::duration<double>(t1 - t0).count());
+    }
+    return best;
+}
+
+// ---------------------------------------------------------------------------
+// Exact running median (edge-padded), O(n log w)
+// ---------------------------------------------------------------------------
+
+void rn_running_median(const float* x, int64_t n, int64_t w, float* out) {
+    const int64_t half = w / 2;
+    // Ordered multiset holding the current window, with an iterator
+    // pinned at rank `half` (the median of the w-element window). On
+    // each slide the incoming element is inserted, the iterator rank is
+    // rebalanced, and one instance of the outgoing element is erased.
+    std::multiset<float> win;
+    auto clip = [&](int64_t j) { return j < 0 ? int64_t(0) : (j >= n ? n - 1 : j); };
+    for (int64_t j = -half; j <= half; ++j) win.insert(x[clip(j)]);
+    auto med = std::next(win.begin(), half);
+    out[0] = *med;
+    for (int64_t i = 1; i < n; ++i) {
+        const float incoming = x[clip(i + half)];
+        const float outgoing = x[clip(i - half - 1)];
+        win.insert(incoming);
+        if (incoming < *med) --med;   // insertion below the median: rank shifts
+        if (outgoing <= *med) ++med;  // removal at/below the median: shift back
+        win.erase(win.lower_bound(outgoing));
+        out[i] = *med;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Real-factor downsampling (double accumulator)
+// ---------------------------------------------------------------------------
+
+void rn_downsample(const float* x, int64_t n, double f, float* out) {
+    const int64_t nout = static_cast<int64_t>(std::floor(n / f));
+    for (int64_t k = 0; k < nout; ++k) {
+        const double start = k * f;
+        const double end = start + f;
+        const int64_t imin = static_cast<int64_t>(std::floor(start));
+        int64_t imax = static_cast<int64_t>(std::floor(end));
+        if (imax > n - 1) imax = n - 1;
+        const double wmin = imin + 1.0 - start;
+        const double wmax = end - imax;
+        double acc = wmin * x[imin] + wmax * x[imax];
+        for (int64_t j = imin + 1; j < imax; ++j) acc += x[j];
+        out[k] = static_cast<float>(acc);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Circular prefix sum + boxcar S/N (double accumulators)
+// ---------------------------------------------------------------------------
+
+void rn_circular_prefix_sum(const float* x, int64_t n, int64_t nsum, double* out) {
+    double acc = 0.0;
+    for (int64_t j = 0; j < (nsum < n ? nsum : n); ++j) {
+        acc += x[j];
+        out[j] = acc;
+    }
+    if (nsum <= n) return;
+    const double total = acc;
+    for (int64_t j = n; j < nsum; ++j) out[j] = out[j - n] + total;
+}
+
+// S/N of each row of a (rows, bins) array for each trial width.
+// out is (rows, nw) float32.
+void rn_boxcar_snr(const float* x, int64_t rows, int64_t bins,
+                   const int64_t* widths, int64_t nw, float stdnoise,
+                   float* out) {
+    int64_t wmax = 0;
+    for (int64_t i = 0; i < nw; ++i) wmax = std::max(wmax, widths[i]);
+    std::vector<double> cpf(bins + wmax);
+    for (int64_t r = 0; r < rows; ++r) {
+        const float* row = x + r * bins;
+        rn_circular_prefix_sum(row, bins, bins + wmax, cpf.data());
+        const double total = cpf[bins - 1];
+        for (int64_t i = 0; i < nw; ++i) {
+            const int64_t w = widths[i];
+            const double h = std::sqrt(double(bins - w) / (double(bins) * w));
+            const double b = double(w) / double(bins - w) * h;
+            // max over all circular phases of the w-bin window sum,
+            // expressed as cpf[j + w] - cpf[j] like the oracle
+            double dmax = -1e300;
+            for (int64_t j = 0; j < bins; ++j)
+                dmax = std::max(dmax, cpf[j + w] - cpf[j]);
+            out[r * nw + i] = static_cast<float>(((h + b) * dmax - b * total) / stdnoise);
+        }
+    }
+}
+
+}  // extern "C"

@@ -59,6 +59,16 @@ class FFAPlan:
         L = num_levels(m)
         R = m + 1
         Z = m
+        # Fast path: the native plan builder fills the same tables in C++
+        # (riptide_tpu/native/src/riptide_native.cpp, rn_ffa_tables);
+        # parity is asserted in tests/test_native.py.
+        from .. import native
+
+        if native.available():
+            self.m = m
+            self.levels = L
+            self.h, self.t, self.shift = native.ffa_tables(m, L)
+            return
         # Identity-carry default: out[i] = buf[i] + buf[Z] (zero row).
         h = np.tile(np.arange(R, dtype=np.int32), (L, 1))
         t = np.full((L, R), Z, dtype=np.int32)

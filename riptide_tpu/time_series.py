@@ -185,17 +185,24 @@ class TimeSeries:
         """
         from .reading import SigprocHeader
 
+        from . import native
+
         sh = SigprocHeader(fname, extra_keys=extra_keys or {})
         metadata = Metadata.from_sigproc(sh)
         nbits = sh["nbits"]
-        with open(fname, "rb") as fobj:
-            fobj.seek(sh.bytesize)
-            if nbits == 32:
-                data = np.fromfile(fobj, dtype=np.float32)
-            elif sh["signed"]:
-                data = np.fromfile(fobj, dtype=np.int8).astype(np.float32)
-            else:
-                data = np.fromfile(fobj, dtype=np.uint8).astype(np.float32)
+        if nbits == 32 and native.available():
+            data = native.read_f32(fname, sh.bytesize, sh.nsamp)
+        else:
+            with open(fname, "rb") as fobj:
+                fobj.seek(sh.bytesize)
+                if nbits == 32:
+                    data = np.fromfile(fobj, dtype=np.float32)
+                elif native.available():
+                    data = native.decode8(fobj.read(), signed=sh["signed"])
+                elif sh["signed"]:
+                    data = np.fromfile(fobj, dtype=np.int8).astype(np.float32)
+                else:
+                    data = np.fromfile(fobj, dtype=np.uint8).astype(np.float32)
         return cls(data, metadata["tsamp"], metadata=metadata)
 
     def to_dict(self):

@@ -1,0 +1,113 @@
+"""
+Parity tests of the native C++ host runtime against the numpy oracles
+(riptide_tpu/ops/reference.py) and the python plan builder. Skipped
+entirely when the toolchain is unavailable.
+"""
+import numpy as np
+import pytest
+
+from riptide_tpu import native
+from riptide_tpu.ops import reference as ref
+from riptide_tpu.ops.plan import FFAPlan, num_levels
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+rng = np.random.default_rng(42)
+
+
+def test_ffa_tables_match_python_plan(monkeypatch):
+    # FFAPlan takes the native fast path when available, so the pure
+    # python builder must be forced explicitly or this test would
+    # compare the C++ tables against themselves.
+    ms = (2, 3, 5, 8, 13, 100, 257)
+    with monkeypatch.context() as mp:
+        mp.setattr(native, "available", lambda: False)
+        plans = [FFAPlan(m) for m in ms]
+    for m, plan in zip(ms, plans):
+        h, t, shift = native.ffa_tables(m, plan.levels)
+        np.testing.assert_array_equal(h, plan.h)
+        np.testing.assert_array_equal(t, plan.t)
+        np.testing.assert_array_equal(shift, plan.shift)
+
+
+def test_ffa_tables_extra_levels_identity():
+    m = 6
+    L = num_levels(m) + 2
+    h, t, shift = native.ffa_tables(m, L)
+    R = m + 1
+    for l in range(num_levels(m), L):
+        np.testing.assert_array_equal(h[l][:m], np.arange(m))
+        assert (t[l] == m).all() and (shift[l] == 0).all()
+        assert h[l][m] == m
+
+
+def test_ffa_transform_matches_oracle():
+    for m, p in ((2, 8), (7, 16), (16, 33), (100, 50)):
+        x = rng.standard_normal((m, p)).astype(np.float32)
+        got = native.ffa_transform(x)
+        want = ref.ffa_transform(x)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_running_median_matches_oracle():
+    x = rng.standard_normal(1000).astype(np.float32)
+    for w in (3, 11, 101):
+        got = native.running_median(x, w)
+        want = ref.running_median(x, w)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_running_median_with_duplicates():
+    x = rng.integers(0, 4, size=500).astype(np.float32)
+    got = native.running_median(x, 21)
+    want = ref.running_median(x, 21)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_downsample_matches_oracle():
+    x = rng.standard_normal(10_000).astype(np.float32)
+    for f in (2.0, 3.7, 13.2):
+        got = native.downsample(x, f)
+        want = ref.downsample(x, f)
+        assert got.size == want.size
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_circular_prefix_sum_matches_oracle():
+    x = rng.standard_normal(257).astype(np.float32)
+    got = native.circular_prefix_sum(x, 400)
+    want = ref.circular_prefix_sum(x, 400)
+    np.testing.assert_allclose(got.astype(np.float32), want, rtol=1e-5, atol=1e-4)
+
+
+def test_boxcar_snr_matches_oracle():
+    x = rng.standard_normal((20, 64)).astype(np.float32)
+    widths = np.array([1, 2, 3, 5, 9])
+    got = native.boxcar_snr(x, widths, stdnoise=2.0)
+    want = ref.boxcar_snr_2d(x, widths, stdnoise=2.0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_decode8():
+    raw = np.array([0, 1, 127, 128, 255], np.uint8).tobytes()
+    np.testing.assert_array_equal(
+        native.decode8(raw, signed=False), [0.0, 1.0, 127.0, 128.0, 255.0]
+    )
+    np.testing.assert_array_equal(
+        native.decode8(raw, signed=True), [0.0, 1.0, 127.0, -128.0, -1.0]
+    )
+
+
+def test_read_f32(tmp_path):
+    x = rng.standard_normal(100).astype(np.float32)
+    path = tmp_path / "x.dat"
+    x.tofile(path)
+    np.testing.assert_array_equal(native.read_f32(path, 0, 100), x)
+    np.testing.assert_array_equal(native.read_f32(path, 40, 10), x[10:20])
+
+
+def test_benchmark_ffa_runs():
+    sec = native.benchmark_ffa(64, 64, loops=2)
+    assert 0 < sec < 10
