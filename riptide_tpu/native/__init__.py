@@ -33,7 +33,20 @@ __all__ = [
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "src", "riptide_native.cpp")
 _BUILD_DIR = os.path.join(_HERE, "_build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libriptide_native.so")
+# Compile flags are part of the cache key: a .so built with different
+# flags (e.g. an old -march=native artifact on a shared filesystem) must
+# not pass the staleness check on a host it could crash.
+_FLAGS = ("-O3", "-std=c++17", "-shared", "-fPIC")
+
+
+def _flags_tag():
+    import hashlib
+
+    # Stable across processes (unlike hash(), which PYTHONHASHSEED salts).
+    return hashlib.sha1(" ".join(_FLAGS).encode()).hexdigest()[:8]
+
+
+_LIB_PATH = os.path.join(_BUILD_DIR, f"libriptide_native_{_flags_tag()}.so")
 
 _lock = threading.Lock()
 _lib = None
@@ -46,11 +59,30 @@ def _f32(flags="C"):
 
 def _build():
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    cmd = [
-        "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-        _SRC, "-o", _LIB_PATH,
-    ]
-    subprocess.run(cmd, check=True, capture_output=True)
+    # Build to a unique temp name and rename into place: concurrent
+    # first-use builds (pytest-xdist, several survey jobs sharing a
+    # filesystem, possibly with colliding PIDs across hosts) must never
+    # truncate a .so another process has mapped.
+    import tempfile
+
+    fd, tmp_path = tempfile.mkstemp(suffix=".so.tmp", dir=_BUILD_DIR)
+    os.close(fd)
+    # No -march=native: the cached .so may be reused from a shared
+    # filesystem by hosts with a narrower ISA, where native-tuned code
+    # dies with SIGILL outside the reach of the numpy-fallback handler.
+    cmd = ["g++", *_FLAGS, _SRC, "-o", tmp_path]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp_path, _LIB_PATH)
+    except subprocess.CalledProcessError as err:
+        # str(CalledProcessError) omits stderr; surface the compiler
+        # diagnostics or build failures are undebuggable.
+        raise RuntimeError(
+            f"native build failed ({err}): {err.stderr.strip()}"
+        ) from err
+    finally:
+        if os.path.exists(tmp_path):
+            os.unlink(tmp_path)
 
 
 def _bind(lib):

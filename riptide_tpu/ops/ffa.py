@@ -22,7 +22,7 @@ import numpy as np
 
 from .plan import ffa_plan
 
-__all__ = ["ffa2", "ffa1", "ffa_levels", "ffafreq", "ffaprd"]
+__all__ = ["ffa2", "ffa1", "ffa_levels", "ffa_transform_padded", "ffafreq", "ffaprd"]
 
 
 def _level_step(buf, tables, p):
@@ -66,9 +66,17 @@ def ffa_levels(buf, h, t, shift, p):
     return out
 
 
-@partial(jax.jit, static_argnums=(1, 2))
-def _ffa2_padded(data, m, p):
+def ffa_transform_padded(data, m, p):
+    """
+    Traceable single-problem transform body: pad an (m, p) block into the
+    (1, m + 1, p) zero-row container, run :func:`ffa_levels` with the
+    cached plan tables, slice back. Shared by :func:`ffa2` and the
+    sequence-parallel path (riptide_tpu.parallel.seqffa) so the buffer
+    contract lives in one place.
+    """
     plan = ffa_plan(m)
+    if plan.levels == 0:
+        return data
     buf = jnp.zeros((1, m + 1, p), jnp.float32).at[0, :m, :].set(data)
     out = ffa_levels(
         buf,
@@ -78,6 +86,9 @@ def _ffa2_padded(data, m, p):
         jnp.asarray([p], jnp.int32),
     )
     return out[0, :m, :]
+
+
+_ffa2_padded = jax.jit(ffa_transform_padded, static_argnums=(1, 2))
 
 
 def ffa2(data):

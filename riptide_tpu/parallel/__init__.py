@@ -12,16 +12,22 @@ optional ``bins`` mesh axis splits each cycle's phase-bin trial batch
 across chips — the tensor-parallel analog for when few DM trials must go
 wide.
 
+For transforms too large for one chip's HBM, sequence parallelism shards
+the fold container's row axis instead (:mod:`riptide_tpu.parallel.seqffa`).
+
 Multi-host: :func:`init_distributed` wraps ``jax.distributed.initialize``;
 all collectives ride XLA over ICI/DCN.
 """
 from .mesh import default_mesh, mesh_2d
 from .sharded import run_periodogram_sharded
+from .seqffa import ffa2_seq, seq_mesh
 from .distributed import init_distributed
 
 __all__ = [
     "default_mesh",
     "mesh_2d",
     "run_periodogram_sharded",
+    "ffa2_seq",
+    "seq_mesh",
     "init_distributed",
 ]

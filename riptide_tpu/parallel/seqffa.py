@@ -1,0 +1,143 @@
+"""
+Sequence-parallel FFA: one fold container split across chips.
+
+The standard production layout keeps every time series chip-local and
+shards the DM batch (see :mod:`riptide_tpu.parallel.sharded`). When a
+single transform is too large for one chip's HBM — very long
+observations folded at short periods — the row axis of the (m, p) fold
+container is sharded over a ``seq`` mesh axis instead.
+
+The FFA merge tree (reference recursion: riptide/cpp/transforms.hpp:30-50,
+flattened into level tables by :mod:`riptide_tpu.ops.plan`) decomposes
+cleanly: with ``m = S * m_local`` rows over ``S`` shards (S a power of
+two), the first ``ceil(log2(m_local))`` levels only combine rows within
+one shard — they ARE the m_local-row plan, run independently per shard
+with zero communication — and the top ``log2(S)`` levels combine rows
+across shards. Those cross levels run as ``all_gather`` over the ICI ring
+followed by a local gather+roll+add of each shard's output rows, so
+compute stays fully sharded and only the folded buffer (m x p floats per
+level) rides the interconnect.
+"""
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as Pspec
+
+from ..ops.ffa import ffa_transform_padded
+from ..ops.plan import ffa_plan, num_levels
+
+__all__ = ["ffa2_seq", "seq_mesh"]
+
+
+def seq_mesh(devices=None, axis_name="seq"):
+    """1-D mesh over all (or the given) devices for sequence parallelism."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def _cross_tables(m, S):
+    """
+    Per-shard slices of the global plan's cross-shard levels.
+
+    Returns (h, t, shift) of shape (L_cross, S, m_local) int32. Row ids
+    are global: 0..m-1 into the gathered buffer, m = the zero row.
+    """
+    m_local = m // S
+    gplan = ffa_plan(m)
+    L_local = num_levels(m_local)
+    h = gplan.h[L_local:, :m]
+    t = gplan.t[L_local:, :m]
+    shift = gplan.shift[L_local:, :m]
+    L_cross = h.shape[0]
+    shape = (L_cross, S, m_local)
+    return (
+        np.ascontiguousarray(h.reshape(shape)),
+        np.ascontiguousarray(t.reshape(shape)),
+        np.ascontiguousarray(shift.reshape(shape)),
+    )
+
+
+def _cross_level(y, h, t, shift, p, axis):
+    """
+    One cross-shard merge level.
+
+    y : (m_local, p) this shard's current rows
+    h, t, shift : (m_local,) int32 — global row ids / shift of this
+        shard's output rows at this level
+    """
+    m_local, P = y.shape
+    full = jax.lax.all_gather(y, axis, axis=0, tiled=True)  # (m, p)
+    full = jnp.concatenate([full, jnp.zeros((1, P), full.dtype)])  # zero row
+    head = full[h]
+    tail = full[t]
+    cols = jnp.arange(P, dtype=jnp.int32)[None, :]
+    idx = (cols + shift[:, None]) % P
+    return head + jnp.take_along_axis(tail, idx, axis=1)
+
+
+def ffa2_seq(data, mesh=None, axis="seq"):
+    """
+    FFA transform of an (m, p) array with rows sharded over a mesh axis.
+
+    Bit-identical semantics to :func:`riptide_tpu.ops.ffa.ffa2` — the
+    reference ``libcpp.ffa2`` contract — but the fold container, all
+    intermediate levels and the output are distributed over the ``axis``
+    axis of ``mesh``. Requires ``m`` divisible by the axis size and the
+    axis size to be a power of two (pick m accordingly; padding rows
+    would change the transform's semantics).
+
+    Returns the full (m, p) float32 result as numpy.
+    """
+    if mesh is None:
+        mesh = seq_mesh()
+    S = mesh.shape[axis]
+    if S & (S - 1):
+        raise ValueError(f"mesh axis {axis!r} size {S} must be a power of two")
+
+    data = np.asarray(data, dtype=np.float32)
+    if data.ndim != 2:
+        raise ValueError("input data must be two-dimensional")
+    m, p = data.shape
+    if m % S:
+        raise ValueError(f"rows ({m}) must be divisible by the mesh axis size ({S})")
+    if S == 1 or m == 1:
+        from ..ops.ffa import ffa2
+
+        return ffa2(data)
+
+    ch, ct, cs = _cross_tables(m, S)
+    fn = _seq_program(m, p, mesh, axis)
+    return np.asarray(fn(data, jnp.asarray(ch), jnp.asarray(ct), jnp.asarray(cs)))
+
+
+@lru_cache(maxsize=64)
+def _seq_program(m, p, mesh, axis):
+    """Compiled shard-mapped transform for one (m, p, mesh, axis) layout —
+    cached so repeated same-shaped calls skip retracing and recompilation."""
+    S = mesh.shape[axis]
+    m_local = m // S
+
+    def shard_fn(x, h, t, shift):
+        # x: (m_local, p); h/t/shift: (L_cross, 1, m_local)
+        y = ffa_transform_padded(x, m_local, p)
+        for lvl in range(h.shape[0]):
+            y = _cross_level(y, h[lvl, 0], t[lvl, 0], shift[lvl, 0], p, axis)
+        return y
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(
+                Pspec(axis, None),
+                Pspec(None, axis, None),
+                Pspec(None, axis, None),
+                Pspec(None, axis, None),
+            ),
+            out_specs=Pspec(axis, None),
+        )
+    )

@@ -1,0 +1,110 @@
+"""
+Candidate construction + diagnostic-plot and Periodogram-plot smoke tests
+(reference: riptide/candidate.py, riptide/periodogram.py plot/display and
+the serialization round trip of riptide/tests/test_ffa_search_pgram.py).
+"""
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pandas
+import pytest
+
+from riptide_tpu import Periodogram, TimeSeries, ffa_search, load_json, save_json
+from riptide_tpu.candidate import Candidate
+from riptide_tpu.peak_detection import Peak
+from riptide_tpu.pipeline.peak_cluster import PeakCluster
+
+
+def _make_peak(snr, dm=0.0, period=1.0, width=3):
+    return Peak(
+        period=period, freq=1.0 / period, width=width, ducy=width / 256.0,
+        iw=0, ip=0, snr=snr, dm=dm,
+    )
+
+
+@pytest.fixture(scope="module")
+def candidate():
+    np.random.seed(0)
+    ts = TimeSeries.generate(length=30.0, tsamp=1e-3, period=1.0, amplitude=25.0)
+    cluster = PeakCluster(
+        [_make_peak(20.0, dm=0.0), _make_peak(18.0, dm=5.0), _make_peak(12.0, dm=10.0)]
+    )
+    return Candidate.from_pipeline_output(ts, cluster, bins=128, subints=8)
+
+
+def test_candidate_attributes(candidate):
+    assert candidate.params["snr"] == 20.0
+    assert candidate.params["dm"] == 0.0
+    assert candidate.subints.shape == (8, 128)
+    assert candidate.profile.shape == (128,)
+    np.testing.assert_allclose(candidate.profile, candidate.subints.sum(axis=0), rtol=1e-6)
+    dms, snrs = candidate.dm_curve
+    assert list(dms) == [0.0, 5.0, 10.0]
+    assert list(snrs) == [20.0, 18.0, 12.0]
+    assert isinstance(candidate.peaks, pandas.DataFrame)
+    assert "Candidate(P0=" in str(candidate)
+
+
+def test_candidate_subints_fallback_when_too_many():
+    """Requested subints that don't fit fall back to one row per period
+    (reference: riptide/candidate.py:89-96)."""
+    np.random.seed(1)
+    ts = TimeSeries.generate(length=10.0, tsamp=1e-3, period=1.0, amplitude=10.0)
+    cluster = PeakCluster([_make_peak(15.0)])
+    cand = Candidate.from_pipeline_output(ts, cluster, bins=64, subints=1000)
+    assert cand.subints.ndim == 2
+    assert cand.subints.shape[0] <= 10  # at most the full periods that fit
+
+
+def test_candidate_plot_smoke(candidate, tmp_path):
+    fig = candidate.plot()
+    assert len(fig.axes) == 4
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)
+    out = tmp_path / "cand.png"
+    candidate.savefig(out)
+    assert out.exists() and out.stat().st_size > 0
+
+
+def test_candidate_json_roundtrip(candidate, tmp_path):
+    fname = tmp_path / "cand.json"
+    save_json(fname, candidate)
+    out = load_json(fname)
+    assert isinstance(out, Candidate)
+    assert out.params == candidate.params
+    assert np.allclose(out.subints, candidate.subints)
+    assert list(out.peaks.columns) == list(candidate.peaks.columns)
+    assert out.tsmeta["source_name"] == candidate.tsmeta["source_name"]
+
+
+@pytest.fixture(scope="module")
+def pgram():
+    np.random.seed(2)
+    ts = TimeSeries.generate(length=20.0, tsamp=1e-3, period=1.0, amplitude=15.0)
+    _, pg = ffa_search(ts, period_min=0.5, period_max=2.0, bins_min=32, bins_max=36)
+    return pg
+
+
+def test_periodogram_plot_smoke(pgram):
+    import matplotlib.pyplot as plt
+
+    fig = plt.figure()
+    pgram.plot()  # max over widths
+    plt.close(fig)
+    fig = plt.figure()
+    pgram.plot(iwidth=0)  # single width trial
+    plt.close(fig)
+
+
+def test_periodogram_json_roundtrip(pgram, tmp_path):
+    fname = tmp_path / "pgram.json"
+    save_json(fname, pgram)
+    out = load_json(fname)
+    assert isinstance(out, Periodogram)
+    assert np.allclose(out.snrs, pgram.snrs)
+    assert np.allclose(out.periods, pgram.periods)
+    assert np.array_equal(out.foldbins, pgram.foldbins)
+    assert np.array_equal(out.widths, pgram.widths)

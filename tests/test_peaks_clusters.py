@@ -1,0 +1,182 @@
+"""
+Unit tests of peak detection and 1-D clustering, mirroring the
+reference's semantics (riptide/peak_detection.py, riptide/clustering.py).
+"""
+import numpy as np
+import pytest
+
+from riptide_tpu.clustering import cluster1d
+from riptide_tpu.peak_detection import (
+    Peak,
+    find_peaks,
+    find_peaks_single,
+    fit_threshold,
+    segment_stats,
+)
+
+
+# ---------------------------------------------------------------- cluster1d
+
+def test_cluster1d_empty():
+    assert cluster1d(np.array([]), 1.0) == []
+
+
+def test_cluster1d_single_cluster():
+    x = np.array([0.0, 0.1, 0.2, 0.3])
+    out = cluster1d(x, 0.15)
+    assert len(out) == 1
+    assert sorted(out[0]) == [0, 1, 2, 3]
+
+
+def test_cluster1d_chained_friends_of_friends():
+    # Chained membership: consecutive gaps all <= r so one cluster even
+    # though the extremes are far apart.
+    x = np.array([0.0, 0.9, 1.8, 2.7])
+    out = cluster1d(x, 1.0)
+    assert len(out) == 1
+
+
+def test_cluster1d_splits_on_gap():
+    x = np.array([0.0, 0.1, 5.0, 5.1, 10.0])
+    out = cluster1d(x, 0.5)
+    groups = [sorted(g.tolist()) for g in out]
+    assert groups == [[0, 1], [2, 3], [4]]
+
+
+def test_cluster1d_unsorted_input_indices_into_original():
+    x = np.array([5.1, 0.0, 5.0, 0.1])
+    out = cluster1d(x, 0.5)
+    groups = sorted(sorted(g.tolist()) for g in out)
+    assert groups == [[0, 2], [1, 3]]
+
+
+def test_cluster1d_already_sorted_flag():
+    x = np.array([0.0, 0.1, 2.0])
+    out = cluster1d(x, 0.5, already_sorted=True)
+    groups = [sorted(g.tolist()) for g in out]
+    assert groups == [[0, 1], [2]]
+
+
+# ------------------------------------------------------------ segment stats
+
+def test_segment_stats_shapes_and_values():
+    # 100 segments of 10 points each over f in [1, 2], T such that
+    # segwidth/T = 0.01.
+    f = np.linspace(2.0, 1.0, 1000)
+    s = np.full(1000, 3.0)
+    fc, smed, sstd = segment_stats(f, s, T=500.0, segwidth=5.0)
+    assert len(fc) == len(smed) == len(sstd) == 100
+    assert np.allclose(smed, 3.0)
+    assert np.allclose(sstd, 0.0)
+    # Segment centres are ordered like f (decreasing here)
+    assert np.all(np.diff(fc) < 0)
+
+
+def test_segment_stats_robust_std():
+    # Gaussian S/N values: IQR/1.349 estimates sigma.
+    rng = np.random.RandomState(0)
+    f = np.linspace(1.0, 2.0, 100_000)
+    s = rng.normal(5.0, 2.0, size=f.size)
+    fc, smed, sstd = segment_stats(f, s, T=10.0, segwidth=5.0)
+    assert np.allclose(smed, 5.0, atol=0.2)
+    assert np.allclose(sstd, 2.0, atol=0.3)
+
+
+def test_fit_threshold_recovers_polynomial():
+    fc = np.exp(np.linspace(0.0, 2.0, 50))
+    tc = 1.5 * np.log(fc) ** 2 - 0.5 * np.log(fc) + 3.0
+    poly = fit_threshold(fc, tc, polydeg=2)
+    assert np.allclose(poly.coefficients, [1.5, -0.5, 3.0], atol=1e-8)
+
+
+# -------------------------------------------------------- find_peaks_single
+
+def test_find_peaks_single_static_fallback():
+    # Too few segments for a dynamic fit: polyco falls back to [smin].
+    f = np.linspace(2.0, 1.0, 100)
+    s = np.zeros(100)
+    s[40] = 50.0
+    idx, polyco = find_peaks_single(f, s, T=10.0, smin=6.0, minseg=10)
+    assert list(polyco) == [6.0]
+    assert idx == [40]
+
+
+def test_find_peaks_single_clusters_adjacent_points():
+    f = np.linspace(2.0, 1.0, 1000)
+    s = np.zeros(1000)
+    s[500:505] = [20.0, 30.0, 40.0, 30.0, 20.0]  # one broad peak
+    s[800] = 25.0  # a second, separate peak
+    idx, _ = find_peaks_single(f, s, T=1000.0, smin=6.0, clrad=5.0)
+    assert sorted(idx) == [502, 800]
+
+
+def test_find_peaks_single_respects_smin():
+    f = np.linspace(2.0, 1.0, 100)
+    s = np.full(100, 1.0)
+    s[10] = 5.9  # below smin
+    idx, _ = find_peaks_single(f, s, T=10.0, smin=6.0)
+    assert idx == []
+
+
+# --------------------------------------------------------------- find_peaks
+
+class _FakePgram:
+    """Minimal Periodogram stand-in for find_peaks unit tests."""
+
+    def __init__(self, freqs, widths, snrs, foldbins, tobs, dm=7.5):
+        self.freqs = freqs
+        self.widths = widths
+        self.snrs = snrs
+        self.foldbins = foldbins
+        self.tobs = tobs
+        self.metadata = {"dm": dm}
+
+
+def test_find_peaks_typed_output():
+    n = 2000
+    freqs = np.linspace(2.0, 1.0, n)
+    widths = np.array([1, 2])
+    snrs = np.zeros((n, 2))
+    snrs[700, 0] = 30.0
+    snrs[700, 1] = 45.0
+    foldbins = np.full(n, 256, dtype=np.uint32)
+    pgram = _FakePgram(freqs, widths, snrs, foldbins, tobs=200.0)
+
+    peaks, polycos = find_peaks(pgram, smin=6.0)
+    assert len(peaks) == 2
+    # Sorted by decreasing S/N; the width-2 trial wins.
+    best = peaks[0]
+    assert isinstance(best, Peak)
+    assert best.snr == 45.0
+    assert best.width == 2
+    assert best.iw == 1
+    assert best.ip == 700
+    assert best.freq == pytest.approx(freqs[700])
+    assert best.period == pytest.approx(1.0 / freqs[700])
+    assert best.ducy == pytest.approx(2.0 / 256.0)
+    assert best.dm == 7.5
+    # Plain python types only (reference: peak_detection.py:210-212)
+    assert type(best.freq) is float
+    assert type(best.width) is int
+    assert type(best.snr) is float
+    assert set(polycos.keys()) == {0, 1}
+    assert best.summary_dict() == {
+        "period": best.period,
+        "freq": best.freq,
+        "dm": 7.5,
+        "width": 2,
+        "ducy": best.ducy,
+        "snr": 45.0,
+    }
+
+
+def test_find_peaks_pure_noise_none_significant():
+    rng = np.random.RandomState(1)
+    n = 5000
+    freqs = np.linspace(2.0, 1.0, n)
+    widths = np.array([1])
+    snrs = rng.normal(0.0, 1.0, size=(n, 1))
+    foldbins = np.full(n, 256, dtype=np.uint32)
+    pgram = _FakePgram(freqs, widths, snrs, foldbins, tobs=200.0)
+    peaks, _ = find_peaks(pgram, smin=7.0)
+    assert peaks == []

@@ -1,0 +1,77 @@
+"""
+End-to-end tests of the rseek CLI on deterministic synthetic data
+(reference: riptide/tests/test_rseek.py — the top candidate of the seeded
+fake pulsar must come out at S/N 18.5 +/- 0.15, width 13, dm 0, freq
+within 0.1/Tobs of 1 Hz; a pure-noise input must return None).
+"""
+import numpy as np
+import pytest
+
+from riptide_tpu.apps.rseek import get_parser, run_program
+
+from synth import generate_data_presto, write_sigproc
+
+TOBS = 128.0
+TSAMP = 256e-6
+PERIOD = 1.0
+
+
+def _run(fname, fmt, extra=()):
+    args = get_parser().parse_args(
+        ["-f", fmt, "--Pmin", "0.5", "--Pmax", "2.0",
+         "--bmin", "480", "--bmax", "520", *extra, str(fname)]
+    )
+    return run_program(args)
+
+
+def test_rseek_finds_fake_pulsar(tmp_path, capsys):
+    inf = generate_data_presto(
+        tmp_path, "fake_pulsar", tobs=TOBS, tsamp=TSAMP, period=PERIOD,
+        dm=0.0, amplitude=20.0, ducy=0.02,
+    )
+    df = _run(inf, "presto")
+    assert df is not None
+    top = df.iloc[0]
+    assert abs(top["freq"] - 1.0 / PERIOD) < 0.1 / TOBS
+    assert int(top["width"]) == 13
+    assert top["dm"] == 0.0
+    assert abs(top["snr"] - 18.5) < 0.15
+    # The peak table is printed for the user
+    out = capsys.readouterr().out
+    assert "period" in out and "snr" in out
+
+
+def test_rseek_sigproc_input(tmp_path):
+    np.random.seed(0)
+    from riptide_tpu import TimeSeries
+
+    ts = TimeSeries.generate(TOBS, TSAMP, PERIOD, amplitude=20.0, ducy=0.02, stdnoise=1.0)
+    fname = tmp_path / "fake_pulsar.tim"
+    write_sigproc(fname, ts.data, TSAMP, nbits=32, refdm=0.0)
+    df = _run(fname, "sigproc")
+    assert df is not None
+    top = df.iloc[0]
+    assert abs(top["freq"] - 1.0 / PERIOD) < 0.1 / TOBS
+    assert abs(top["snr"] - 18.5) < 0.15
+
+
+def test_rseek_pure_noise_returns_none(tmp_path, capsys):
+    np.random.seed(42)
+    noise = np.random.normal(size=int(32.0 / 1e-3)).astype(np.float32)
+    from synth import write_presto
+
+    inf = write_presto(tmp_path, "noise", noise, 1e-3)
+    args = get_parser().parse_args(
+        ["-f", "presto", "--Pmin", "1.0", "--Pmax", "2.0",
+         "--bmin", "240", "--bmax", "260", str(inf)]
+    )
+    assert run_program(args) is None
+    assert "No peaks found" in capsys.readouterr().out
+
+
+def test_rseek_parser_defaults():
+    args = get_parser().parse_args(["-f", "presto", "x.inf"])
+    assert args.Pmin == 1.0 and args.Pmax == 10.0
+    assert args.bmin == 240 and args.bmax == 260
+    assert args.smin == 7.0 and args.wtsp == 1.5
+    assert args.rmed_width == 4.0 and args.clrad == 0.2
