@@ -1,0 +1,303 @@
+"""
+Fused Pallas TPU kernel: FFA transform + boxcar S/N for one cascade
+cycle's bins-trial batch.
+
+One grid program processes one (m_b, p_b) problem entirely in VMEM:
+the container never round-trips to HBM between merge levels, which is
+what makes this ~1000x faster than the round-1 gather formulation (HBM
+scalar gathers measured at ~100 ns/element; the dense rolls/selects here
+run at VMEM bandwidth). The operation sequence is the verified dense
+algorithm of :mod:`riptide_tpu.ops.slottables` (`simulate_dense` ==
+reference oracle, exact): natural K-way levels, 2-D spread, slot levels
+with interleaved row-doubling + delta selects, lane barrel + mod-p wrap
+select for every phase roll, then the reference's matched-filter S/N
+(riptide/cpp/snr.hpp:37-65) computed from an in-VMEM prefix sum.
+
+Inputs per problem (program b of the grid):
+  x     (B, rows, P)  f32 natural-packed rows (zero padded), HBM
+  tab   (B, T, rows, 128) int32 packed level words (slottables layout),
+        lane-replicated on device, HBM; T = NL + 2*(L - NL)
+  scal  (B, 32) int32 SMEM: [0]=p, [1]=P-p, [2+2j], [3+2j] = spread
+        roll amounts of step j (precomputed mod rows)
+  coef  (B, 32) f32 SMEM: [w] = (h_w+b_w)/stdnoise, [NWPAD+w] = b_w/stdnoise
+Output:
+  snr   (B, RS, 128) f32; lanes [0, NW) hold widths, rows [0, m) valid.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .slottables import (A_SHIFT, A_BITS, B_SHIFT, B_BITS, NAT_LEVELS,
+                         build_tables)
+
+__all__ = ["ffa_snr_cycle", "NWPAD"]
+
+NWPAD = 16  # coef slots reserved per coefficient bank
+
+
+def _roll_r(x, c, rows):
+    """Read rows shifted: out[u] = x[u - c mod rows] (c static)."""
+    c %= rows
+    return x if c == 0 else pltpu.roll(x, c, axis=0)
+
+
+def _lane_up(x, c, P):
+    """out[..., j] = x[..., j + c mod P] (c static)."""
+    c %= P
+    return x if c == 0 else pltpu.roll(x, (P - c) % P, axis=1)
+
+
+def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
+            *, L, NL, rows, P, RS, widths, nspread):
+    b = pl.program_id(0)
+    p = scal[b, 0]
+
+    cp = pltpu.make_async_copy(x_hbm.at[b], A, semx)
+    cp.start()
+    cp.wait()
+
+    cols = jax.lax.broadcasted_iota(jnp.int32, (rows, P), 1)
+    colmask = cols < p
+
+    def load_tab(lev):
+        cpt = pltpu.make_async_copy(tab_hbm.at[b, lev], T, semt)
+        cpt.start()
+        cpt.wait()
+        return jnp.broadcast_to(T[:, :1], (rows, P))
+
+    def tail_wrap(tail, sig, thr, nbits):
+        for k in range(nbits):
+            rolled = _lane_up(tail, 1 << k, P)
+            tail = jnp.where(((sig >> k) & 1) != 0, rolled, tail)
+        # wrap branch: value one extra (P - p) ahead on the ring
+        wrapped = pltpu.roll(tail, p, axis=1)
+        return jnp.where(cols < thr, tail, wrapped)
+
+    bufs = [A, Bs]
+    cur = 0
+
+    # ---- natural levels -------------------------------------------------
+    for l in range(1, NL + 1):
+        src, dst = bufs[cur], bufs[1 - cur]
+        w = load_tab(l - 1)
+        valid = w < 0
+        af = (w >> A_SHIFT) & ((1 << A_BITS) - 1)
+        bf = (w >> B_SHIFT) & ((1 << B_BITS) - 1)
+        lone = bf == (1 << B_BITS) - 1
+        sv = src[:]
+        head = sv
+        for c in range(1, 1 << l):
+            head = jnp.where(af == c, _roll_r(sv, c, rows), head)
+        dst[:] = head
+        tail = jnp.zeros((rows, P), jnp.float32)
+        for bv in range(0, (1 << (l - 1)) + 2):
+            tail = jnp.where(bf == bv, _roll_r(sv, 1 - bv, rows), tail)
+        tail = tail_wrap(tail, w & 0x1FF, (w >> 9) & 0x1FF, min(l, 9))
+        dst[:] = jnp.where(
+            valid & colmask,
+            dst[:] + jnp.where(lone, 0.0, tail),
+            0.0,
+        )
+        cur = 1 - cur
+
+    # ---- spread steps ---------------------------------------------------
+    for j in range(nspread):
+        src, dst = bufs[cur], bufs[1 - cur]
+        w = load_tab(NL + j)
+        sel = (w >> 22) & 3
+        sv = src[:]
+        c1 = pltpu.roll(sv, scal[b, 2 + 2 * j], axis=0)
+        c2 = pltpu.roll(sv, scal[b, 3 + 2 * j], axis=0)
+        out = jnp.where(sel == 1, c1, sv)
+        out = jnp.where(sel == 2, c2, out)
+        dst[:] = jnp.where(w < 0, out, 0.0)
+        cur = 1 - cur
+
+    # ---- slot levels ----------------------------------------------------
+    for l in range(NL + 1, L + 1):
+        src, dst = bufs[cur], bufs[1 - cur]
+        w = load_tab(NL + nspread + (l - NL - 1))
+        G = 1 << (L - l)
+        S_d = 1 << l
+        S_c = S_d >> 1
+        v = src[:].reshape(G, 2, S_c, P)
+        reph = jnp.repeat(v[:, 0], 2, axis=1)          # (G, S_d, P)
+        w3 = w.reshape(G, S_d, P)
+        da = (w3 >> A_SHIFT) & 3
+        head = reph
+        for dv in (0, 1, 3):
+            delta = dv - 2
+            cand = pltpu.roll(reph, (-delta) % S_d, axis=1)
+            head = jnp.where(da == dv, cand, head)
+        dst[:] = head.reshape(rows, P)
+        rept = jnp.repeat(v[:, 1], 2, axis=1)
+        db = (w3 >> B_SHIFT) & 3
+        tail = rept
+        for dv in (0, 1, 3):
+            delta = dv - 2
+            cand = pltpu.roll(rept, (-delta) % S_d, axis=1)
+            tail = jnp.where(db == dv, cand, tail)
+        tail = tail.reshape(rows, P)
+        tail = tail_wrap(tail, w & 0x1FF, (w >> 9) & 0x1FF, min(l, 9))
+        dst[:] = jnp.where((w < 0) & colmask, dst[:] + tail, 0.0)
+        cur = 1 - cur
+
+    # ---- boxcar S/N -----------------------------------------------------
+    src = bufs[cur]
+    xv = src[0:RS, :]
+    ccols = cols[0:RS, :]
+    cs = xv
+    for k in range(9):
+        if (1 << k) >= P:
+            break
+        sh = jnp.where(ccols >= (1 << k), pltpu.roll(cs, 1 << k, axis=1), 0.0)
+        cs = cs + sh
+    total = jnp.broadcast_to(cs[:, P - 1 : P], (RS, P))
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (RS, 128), 1)
+    acc = jnp.zeros((RS, 128), jnp.float32)
+    neg = jnp.float32(-3.0e38)
+    for iw, wdt in enumerate(widths):
+        aw = _lane_up(cs, wdt, P)
+        bw = pltpu.roll(aw, p, axis=1)
+        maskw = ccols < (p - wdt)
+        d = jnp.where(maskw, aw, bw + total) - cs
+        d = jnp.where(ccols < p, d, neg)
+        dmax = jnp.max(d, axis=1, keepdims=True)
+        snr_w = coef[b, iw] * dmax - coef[b, NWPAD + iw] * total[:, :1]
+        acc = acc + jnp.where(lanes == iw, jnp.broadcast_to(snr_w, (RS, 128)), 0.0)
+    out_ref[0] = acc
+
+
+def _pack_scal(tables, rows):
+    """(B, 32) int32 scalar bank for one bucket's problems."""
+    B = len(tables)
+    scal = np.zeros((B, 32), np.int32)
+    for i, t in enumerate(tables):
+        scal[i, 0] = t.p
+        # P - p is implied by the kernel's static P; slot [1] kept for
+        # debugging only.
+        for j, A in enumerate(t.spread):
+            half = rows >> (j + 1)
+            scal[i, 2 + 2 * j] = (half - A) % rows
+            scal[i, 3 + 2 * j] = (half - A - 1) % rows
+    return scal
+
+
+def _pack_coef(ps, widths, hcoef, bcoef, stdnoise):
+    """(B, 32) f32 coefficient bank: (h+b)/std then b/std."""
+    B = len(ps)
+    nw = len(widths)
+    coef = np.zeros((B, 32), np.float32)
+    coef[:, :nw] = (hcoef + bcoef) / stdnoise[:, None]
+    coef[:, NWPAD : NWPAD + nw] = bcoef / stdnoise[:, None]
+    return coef
+
+
+@functools.lru_cache(maxsize=64)
+def _build_call(L, NL, rows, P, RS, widths, nspread, B, interpret):
+    kern = functools.partial(
+        _kernel, L=L, NL=NL, rows=rows, P=P, RS=RS,
+        widths=widths, nspread=nspread,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, RS, 128), lambda b: (b, 0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, P), jnp.float32),
+            pltpu.VMEM((rows, P), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    call = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, RS, 128), jnp.float32),
+        interpret=bool(interpret),
+    )
+    return jax.jit(call)
+
+
+class CycleKernel:
+    """Host-side bundle: tables + jitted pallas call for one bucket.
+
+    Parameters
+    ----------
+    ms, ps : per-problem row/bin counts (equal length B)
+    widths : static boxcar ladder
+    hcoef, bcoef : (B, NW) float arrays
+    stdnoise : (B,) float
+    L : bucket depth (>= max over ceil(log2 m))
+    """
+
+    def __init__(self, ms, ps, widths, hcoef, bcoef, stdnoise, L=None,
+                 interpret=False):
+        ms = [int(m) for m in ms]
+        ps = [int(p) for p in ps]
+        from .plan import num_levels
+
+        Lmin = max(num_levels(m) for m in ms)
+        self.L = L = Lmin if L is None else max(int(L), Lmin)
+        self.NL = NL = min(L, NAT_LEVELS)
+        self.rows = rows = 1 << L
+        pmax = max(ps)
+        self.P = P = ((pmax + 127) // 128) * 128
+        mmax = max(ms)
+        self.RS = RS = min(rows, ((mmax + 7) // 8) * 8)
+        self.widths = widths = tuple(int(w) for w in widths)
+        self.B = B = len(ms)
+        self.nspread = L - NL
+
+        tabs = [build_tables(m, p, L) for m, p in zip(ms, ps)]
+        T = NL + 2 * (L - NL)
+        words = np.zeros((B, T, rows), np.int32)
+        for i, t in enumerate(tabs):
+            words[i, :NL] = t.nat_words
+            if L > NL:
+                words[i, NL : NL + self.nspread] = t.spread_words
+                words[i, NL + self.nspread :] = t.slot_words
+        self.words = words
+        self.scal = _pack_scal(tabs, rows)
+        self.coef = _pack_coef(ps, widths, np.asarray(hcoef),
+                               np.asarray(bcoef), np.asarray(stdnoise))
+        self.interpret = bool(interpret)
+        self._dev = None
+
+    def _operands(self):
+        if self._dev is None:
+            # Lane-replicate the packed words on DEVICE (cheap broadcast;
+            # host->device ships only the compact (B, T, rows) tensor).
+            w = jnp.asarray(self.words)
+            wrep = jnp.broadcast_to(w[..., None], w.shape + (128,))
+            self._dev = (
+                jnp.asarray(self.scal),
+                jnp.asarray(self.coef),
+                jnp.asarray(wrep),
+            )
+        return self._dev
+
+    def __call__(self, x):
+        """x: (B, rows, P) f32 natural-packed container. Returns
+        (B, RS, 128) f32 S/N block."""
+        scal, coef, wrep = self._operands()
+        call = _build_call(self.L, self.NL, self.rows, self.P, self.RS,
+                           self.widths, self.nspread, self.B, self.interpret)
+        return call(scal, coef, x, wrep)
+
+
+def ffa_snr_cycle(kernel: CycleKernel, x):
+    return kernel(x)

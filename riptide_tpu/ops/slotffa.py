@@ -1,0 +1,183 @@
+"""
+Slot-layout FFA planning: the gather-free formulation of the transform.
+
+The reference computes the FFA as a recursive divide-in-half merge tree
+(riptide/cpp/transforms.hpp:30-50). The round-1 TPU executor flattened
+that recursion into per-level row/column *gathers* — which measure at
+~100 ns/element on TPU (scalar lowering) and dominated the round-1
+benchmark. This module reformulates every level as **dense** operations
+only (static slices, power-of-two row/lane rolls, selects), which is
+what the Pallas kernel in :mod:`riptide_tpu.ops.ffa_kernel` executes
+from VMEM.
+
+Layout
+------
+All 2**L tree nodes of one depth ``d`` are stored in equal *slots* of
+``S_d = 2**(L-d)`` rows (last-level slots hold single rows), so a node's
+rows live at ``[k*S_d, k*S_d + size(d, k))``. Key closed form (verified
+against the recursion in tests): the node at depth ``d``, index ``k``
+(bits of ``k`` = head/tail path from the root) folds
+
+    size(d, k) = (m + bitrev_d(k)) >> d
+
+rows, where ``bitrev_d(k)`` reverses the low ``d`` bits of ``k``. The
+head child (2k) gets ``size >> 1`` rows, matching the reference's
+``head = rows / 2`` split (riptide/cpp/block.hpp:30).
+
+With slots in place, one merge level becomes, for every output row
+``u = k*S_d + s`` (``S_c = S_d / 2``):
+
+    out[u] = buf[u - dh(u)] + roll_p(buf[u + S_c - sigma(u)], -sigma(u))
+
+where ``sigma(u) = s - t(s)`` is the tail phase shift of the reference
+merge (riptide/cpp/transforms.hpp:13-27) and ``dh(u) = s - h(s)``. Both
+row reads are *upward* shifts bounded by ``S_c + 1``, so they and the
+phase roll all execute as log2-depth barrel shifts of power-of-two
+rolls + selects — no gather anywhere. Tables built here (float32 index
+rounding identical to the reference, via ``_merge_mapping``).
+"""
+from functools import lru_cache
+
+import numpy as np
+
+from .plan import num_levels
+from .reference import _merge_mapping
+
+__all__ = ["node_sizes", "leaf_rows", "SlotLevel", "SlotPlan", "slot_plan",
+           "slot_transform_np"]
+
+
+def _bitrev(k, d):
+    """Reverse the low d bits of (array) k."""
+    k = np.asarray(k)
+    out = np.zeros_like(k)
+    for i in range(d):
+        out |= ((k >> i) & 1) << (d - 1 - i)
+    return out
+
+
+def node_sizes(m, d):
+    """Row counts of all 2**d depth-d nodes of an m-row FFA tree, in slot
+    order: size(d, k) = (m + bitrev_d(k)) >> d."""
+    k = np.arange(1 << d, dtype=np.int64)
+    return (m + _bitrev(k, d)) >> d
+
+
+def leaf_rows(m, L):
+    """Natural input-row index held by each of the 2**L leaf slots
+    (-1 for empty slots): the exclusive cumsum of leaf sizes."""
+    sz = node_sizes(m, L)
+    r0 = np.concatenate(([0], np.cumsum(sz)[:-1]))
+    return np.where(sz > 0, r0, -1).astype(np.int64)
+
+
+class SlotLevel:
+    """Dense tables for one merge level of one problem.
+
+    Level ``l`` (1-based) merges depth ``L-l+1`` children into depth
+    ``d = L-l`` parents. All arrays have length ``rows = 2**L`` (the
+    constant container height); entries of invalid rows are zero.
+
+    Attributes
+    ----------
+    dh : (rows,) int64 -- head-read upward row drift, ``s - h(s)``.
+    sigma : (rows,) int64 -- tail phase shift AND tail-read row drift
+        (after the static ``S_c`` pre-shift), ``s - t(s)``.
+    valid : (rows,) bool -- rows holding real output data.
+    """
+
+    def __init__(self, m, L, l):
+        d = L - l
+        S_d = 1 << l
+        S_c = S_d >> 1
+        rows = 1 << L
+        sizes = node_sizes(m, d)          # (2**d,)
+        csizes = node_sizes(m, d + 1)     # (2**(d+1),)
+
+        dh = np.zeros(rows, np.int64)
+        sigma = np.zeros(rows, np.int64)
+        valid = np.zeros(rows, bool)
+        for k in range(1 << d):
+            mn = int(sizes[k])
+            if mn == 0:
+                continue
+            base = k * S_d
+            valid[base : base + mn] = True
+            if mn == 1:
+                # Children are (0, 1): the single row is carried from the
+                # tail child at row base + S_c; head slot is all-zero.
+                # out[base] = buf[base] (zeros) + buf[base + S_c - 0]:
+                # dh = 0 reads the empty head slot, sigma = 0.
+                continue
+            mh = int(csizes[2 * k])
+            assert mh == mn // 2, (m, L, l, k, mn, mh)
+            h, t, sh = _merge_mapping(mn)
+            s = np.arange(mn)
+            dh[base : base + mn] = s - h
+            sigma[base : base + mn] = sh  # == s - t
+            # Row-read bounds that the barrel bit-width relies on.
+            assert (s - h >= 0).all() and (s - h <= S_c + 1).all()
+            assert (sh >= 0).all() and (sh <= S_c + 1).all()
+
+        self.l = l
+        self.S_c = S_c
+        self.dh = dh
+        self.sigma = sigma
+        self.valid = valid
+
+
+class SlotPlan:
+    """All levels of an m-row transform in the 2**L slot container."""
+
+    def __init__(self, m, L=None):
+        m = int(m)
+        Lmin = num_levels(m)
+        L = Lmin if L is None else int(L)
+        if L < Lmin:
+            raise ValueError("L must be >= ceil(log2(m))")
+        self.m = m
+        self.L = L
+        self.rows = 1 << L
+        self.leaf = leaf_rows(m, L)
+        self.levels = [SlotLevel(m, L, l) for l in range(1, L + 1)]
+
+
+@lru_cache(maxsize=512)
+def slot_plan(m, L=None):
+    return SlotPlan(m, L)
+
+
+def _roll_rows_up(buf, drift):
+    """buf[u + drift[u]] per row, via explicit numpy take (oracle only)."""
+    rows = buf.shape[0]
+    idx = np.clip(np.arange(rows) + drift, 0, rows - 1)
+    return buf[idx]
+
+
+def slot_transform_np(data, L=None):
+    """
+    Numpy oracle of the slot-layout algorithm: must equal
+    :func:`riptide_tpu.ops.reference.ffa_transform` exactly. Exists to
+    pin down the index algebra the Pallas kernel implements with dense
+    rolls; uses the same per-level (dh, sigma) tables.
+    """
+    data = np.asarray(data, dtype=np.float32)
+    m, p = data.shape
+    plan = slot_plan(m, L)
+    rows = plan.rows
+
+    buf = np.zeros((rows, p), np.float32)
+    occ = plan.leaf >= 0
+    buf[occ] = data[plan.leaf[occ]]
+
+    cols = np.arange(p)
+    for lev in plan.levels:
+        # Head read: rows shifted up by dh within the same slot range
+        # (reads the all-zero head slot for carry rows).
+        head = _roll_rows_up(buf, -lev.dh)
+        # Tail read: static down-shift by S_c, then up by sigma.
+        tail = _roll_rows_up(buf, lev.S_c - lev.sigma)
+        sig = np.mod(lev.sigma, p)[:, None]
+        rolled = np.take_along_axis(tail, (cols[None, :] + sig) % p, axis=1)
+        buf = np.where(lev.valid[:, None], head + rolled, 0.0).astype(np.float32)
+    return buf[:m]

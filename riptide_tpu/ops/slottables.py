@@ -1,0 +1,342 @@
+"""
+Table construction + dense-op simulator for the Pallas FFA kernel.
+
+The kernel (riptide_tpu/ops/ffa_kernel.py) executes the slot-layout FFA
+of :mod:`riptide_tpu.ops.slotffa` using ONLY dense primitives: static
+row/lane rolls, elementwise selects against precomputed per-row tables,
+and one dynamic whole-array roll per problem (the mod-p wrap). This
+module builds those tables on the host and provides a numpy simulator
+(`simulate_dense`) that performs the *identical* sequence of dense
+operations, so kernel correctness reduces to "kernel == simulator"
+(cheap, via interpret mode) plus "simulator == reference oracle"
+(asserted here against riptide/cpp/transforms.hpp semantics through
+ops.reference.ffa_transform).
+
+Pipeline per problem (m rows of p phase bins, bucket depth L):
+
+1. natural phase  -- levels 1..E (E = min(L, 3)) merge in natural row
+   layout. Row reads stay within +/-4 rows => K-way select over static
+   row rolls, driven by two small per-row offset tables (ah, at).
+2. spread phase   -- L-E halving steps move completed depth-(L-E) nodes
+   into uniform power-of-two slots of 8 rows (3-D steps: 2 static rolls
+   + per-group select), giving the slot container of `slotffa`.
+3. slot phase     -- levels E+1..L with the interleave trick: per-slot
+   row-doubling (jnp.repeat) + delta in [-2, 1] select, exact because
+   the reference's float32 index rounding keeps h(s), t(s) within 2 of
+   s/2 (asserted below).
+4. Phase rolls    -- every level's tail roll = lane barrel over the bits
+   of sigma mod p + one wrap select against `thr = p - sigma mod p`,
+   using the problem's dynamic whole-array roll by (P - p).
+
+All tables are packed per row into one int32 (see pack_level_word).
+"""
+import numpy as np
+
+from .reference import _merge_mapping
+from .slotffa import node_sizes
+from .plan import num_levels
+
+__all__ = [
+    "KernelTables", "build_tables", "simulate_dense",
+    "NAT_LEVELS", "SLOT_S",
+]
+
+NAT_LEVELS = 3      # levels executed in natural layout
+SLOT_S = 8          # slot size after the spread (2**NAT_LEVELS)
+
+# packed word layout (int32):
+#   bits 0-8   sigma mod p            (lane roll;  < p <= 511)
+#   bits 9-17  thr = p - sigma mod p  (wrap-select threshold, 1..511)
+#   bits 18-20 field A: natural phase: head row drift  s - h(s)   in [0,7]
+#              slot phase:    delta_h + 2                          in [0,3]
+#   bits 21-24 field B: natural phase: tail row offset  (biased)   in [0,15]
+#              slot phase:    delta_t + 2                          in [0,3]
+#   bit  31    valid (sign bit)
+A_SHIFT, A_BITS = 18, 3
+B_SHIFT, B_BITS = 21, 4
+
+
+def pack_word(sigma_mod, thr, a, b, valid):
+    w = (
+        (sigma_mod & 0x1FF)
+        | ((thr & 0x1FF) << 9)
+        | ((a & ((1 << A_BITS) - 1)) << A_SHIFT)
+        | ((b & ((1 << B_BITS) - 1)) << B_SHIFT)
+    )
+    # valid lives in bit 31 == the int32 sign bit, so kernels test `w < 0`.
+    return np.where(valid, w | (1 << 31), w).astype(np.int64).astype(np.int32)
+
+
+class KernelTables:
+    """All static tables + metadata for one problem in one bucket.
+
+    Attributes
+    ----------
+    m, p, L : problem shape and bucket depth.
+    nat_words : (NL, m_pad) int64 -- packed words for natural levels
+        (NL = min(L, NAT_LEVELS)); row dimension padded to `nat_rows`.
+    spread_hi : list over steps of (groups,) int8 -- 1 where the group's
+        head size is the larger candidate (mh == A+1).
+    spread_sizes : list over steps of ((groups,) head-size-A, child rows)
+    slot_words : (L - NL, rows) int64 -- packed words for slot levels.
+    """
+
+
+def _merge_tables(mn):
+    """(h, t, sigma) for an mn-row merge; mn >= 2."""
+    return _merge_mapping(mn)
+
+
+def build_tables(m, p, L=None):
+    """Build all kernel tables for one (m, p) problem at bucket depth L."""
+    m, p = int(m), int(p)
+    Lmin = num_levels(m)
+    L = Lmin if L is None else int(L)
+    assert L >= Lmin
+    NL = min(L, NAT_LEVELS)
+    rows = 1 << L
+    t = KernelTables()
+    t.m, t.p, t.L, t.NL = m, p, L, NL
+
+    # ---- natural phase -------------------------------------------------
+    # Level l (1..NL) merges depth d+1 = L-l+1 children into depth d
+    # nodes, all in natural packing. For output row u = R0(d,k) + s:
+    #   head read  u - dh,   dh = s - h(s)          in [0, 2**l - 1]
+    #   tail read  u + o,    o  = mh + t(s) - s = mh - sigma(s)
+    #                                               in [-1, 2**(l-1)]
+    # Field B stores o + 1 (sentinel all-ones marks a lone carried row).
+    nat_words = np.zeros((NL, rows), np.int32)
+    for l in range(1, NL + 1):
+        d = L - l
+        sizes = node_sizes(m, d)
+        csizes = node_sizes(m, d + 1)
+        r0 = np.concatenate(([0], np.cumsum(sizes)[:-1]))
+        sig = np.zeros(rows, np.int64)
+        dh = np.zeros(rows, np.int64)
+        bb = np.zeros(rows, np.int64)
+        val = np.zeros(rows, bool)
+        for k in range(1 << d):
+            mn = int(sizes[k])
+            if mn == 0:
+                continue
+            base = int(r0[k])
+            val[base : base + mn] = True
+            if mn == 1:
+                # lone row carries itself: head read self, no tail.
+                # dh = 0; mark tail invalid via sigma/thr: we encode
+                # "no tail" as B = 0 with zero-read? Instead: tail read
+                # offset o chosen to read row itself with sigma=0 and
+                # head reads ZERO... Simpler: head = self (dh = 0),
+                # tail weight zero: set B to the sentinel 2**B_BITS - 1.
+                bb[base] = (1 << B_BITS) - 1
+                continue
+            mh = int(csizes[2 * k])
+            h, tt, sh = _merge_tables(mn)
+            s = np.arange(mn)
+            dh[base : base + mn] = s - h
+            o = mh + tt - s                      # tail read offset
+            bb[base : base + mn] = o + 1         # in [0, 2**(l-1) + 1]
+            sig[base : base + mn] = sh
+            assert (s - h >= 0).all() and (s - h < (1 << A_BITS)).all()
+            assert (o + 1 >= 0).all() and (o + 1 < (1 << B_BITS) - 1).all(), (m, l)
+        sigm = sig % p
+        thr = p - sigm
+        nat_words[l - 1] = pack_word(sigm, thr, dh, bb, val)
+    t.nat_words = nat_words
+
+    # ---- spread phase --------------------------------------------------
+    # After the natural phase, depth D0 = L - NL nodes are complete and
+    # contiguously packed. Halving steps j = 0..D0-1 split depth-j node
+    # groups into their two children, padding each to the power-of-two
+    # slot: state (2**j, 2**(L-j)) -> (2**(j+1), 2**(L-j-1)) rows.
+    # Per step only two candidate head sizes exist: A and A+1.
+    # Each step is fully 2-D: output row u (slot 2g+child of the step's
+    # output layout, in-slot index i) reads input flat row
+    #   g*S + (child ? mh(g) + i : i)  =  u + child*(mh(g) - half),
+    # i.e. one of THREE static row offsets {0, A - half, A + 1 - half}.
+    # Per-row word: bits 22-23 select the candidate (0 head, 1 tail with
+    # mh = A, 2 tail with mh = A + 1); sign bit = row valid.
+    spread = []
+    spread_words = np.zeros((max(L - NL, 0), rows), np.int32)
+    for j in range(L - NL):
+        sizes = node_sizes(m, j)
+        mh = sizes >> 1                 # head child sizes
+        A = int(mh.min()) if len(mh) else 0
+        hi = (mh > A).astype(np.int64)
+        assert int(mh.max()) <= A + 1
+        spread.append(A)
+        half = rows >> (j + 1)
+        iota = np.arange(rows)
+        g = iota >> (L - j)             # parent group
+        child = (iota >> (L - j - 1)) & 1
+        i = iota & (half - 1)
+        mh_g = mh[g]
+        cnt = np.where(child == 0, mh_g, sizes[g] - mh_g)
+        sel = np.where(child == 0, 0, 1 + hi[g])
+        w = sel << 22
+        spread_words[j] = np.where(i < cnt, w | (1 << 31), w).astype(np.int64).astype(np.int32)
+    t.spread = spread
+    t.spread_words = spread_words
+
+    # ---- slot phase ----------------------------------------------------
+    # Levels l = NL+1 .. L in the uniform slot container (2**L rows,
+    # slot size S_d = 2**l for outputs). Tables per output row
+    # u = k * S_d + s:
+    #   delta_h = 2*h(s) - s  in [-2, 1]
+    #   delta_t = 2*t(s) - s  in [-2, 1]
+    slot_words = np.zeros((L - NL, rows), np.int32)
+    for l in range(NL + 1, L + 1):
+        d = L - l
+        S_d = 1 << l
+        sizes = node_sizes(m, d)
+        csizes = node_sizes(m, d + 1)
+        sig = np.zeros(rows, np.int64)
+        da = np.zeros(rows, np.int64)
+        db = np.zeros(rows, np.int64)
+        val = np.zeros(rows, bool)
+        for k in range(1 << d):
+            mn = int(sizes[k])
+            if mn == 0:
+                continue
+            base = k * S_d
+            val[base : base + mn] = True
+            if mn == 1:
+                # carry: tail child holds the row (head child empty).
+                # delta_t for s=0 must read tails[k, 0]: 2*t - s = 0.
+                da[base] = 2      # delta_h = 0 -> reads empty head slot (zeros)
+                db[base] = 2      # delta_t = 0
+                continue
+            h, tt, sh = _merge_tables(mn)
+            s = np.arange(mn)
+            dlh = 2 * h - s
+            dlt = 2 * tt - s
+            assert (dlh >= -2).all() and (dlh <= 1).all(), (m, l, k)
+            assert (dlt >= -2).all() and (dlt <= 1).all(), (m, l, k)
+            da[base : base + mn] = dlh + 2
+            db[base : base + mn] = dlt + 2
+            sig[base : base + mn] = sh
+        sigm = sig % p
+        thr = p - sigm
+        slot_words[l - NL - 1] = pack_word(sigm, thr, da, db, val)
+    t.slot_words = slot_words
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Dense-op simulator: numpy mirror of the kernel's operation sequence.
+# ---------------------------------------------------------------------------
+
+def _lane_roll(x, c):
+    """Circular roll of phase lanes by +c: out[..., j] = x[..., j + c mod P]."""
+    return np.roll(x, -c, axis=-1)
+
+
+def _row_roll(x, c):
+    """Roll rows by +c upward reads: out[u] = x[u + c mod rows]."""
+    return np.roll(x, -c, axis=0)
+
+
+def _tail_lane_roll(tail, words, p, P):
+    """Barrel lane roll by sigma-mod-p with the two-pass mod-p wrap."""
+    sigm = (words & 0x1FF).astype(np.int64)
+    thr = ((words >> 9) & 0x1FF).astype(np.int64)
+    acc = tail
+    for k in range(9):
+        if not ((sigm >> k) & 1).any():
+            continue
+        rolled = _lane_roll(acc, 1 << k)
+        acc = np.where((((sigm >> k) & 1) != 0)[:, None], rolled, acc)
+    # Wrap branch: for j >= p - sigma the window crosses the phase ring;
+    # the correct value sits one further whole-array roll of (P - p) on:
+    #   wrapped[j] = acc[(j + P - p) mod P] = tail[(j + sigma + P - p) mod P]
+    # which lands on tail[j + sigma - p] for the wrap region.
+    wrapped = _lane_roll(acc, P - p)
+    cols = np.arange(P)
+    return np.where(cols[None, :] < thr[:, None], acc, wrapped)
+
+
+def simulate_dense(data, L=None, P=None):
+    """
+    Execute the kernel's dense-op sequence in numpy. `data` is (m, p);
+    returns the (m, p) FFA transform (must equal ffa_transform exactly).
+    """
+    data = np.asarray(data, dtype=np.float32)
+    m, p = data.shape
+    t = build_tables(m, p, L)
+    L, NL = t.L, t.NL
+    rows = 1 << L
+    P = p if P is None else int(P)
+    cols = np.arange(P)
+    colmask = (cols < p)[None, :]
+
+    buf = np.zeros((rows, P), np.float32)
+    buf[:m, :p] = data
+
+    # natural phase
+    for l in range(1, NL + 1):
+        w = t.nat_words[l - 1]
+        valid = w < 0
+        a = ((w >> A_SHIFT) & ((1 << A_BITS) - 1)).astype(np.int64)
+        b = ((w >> B_SHIFT) & ((1 << B_BITS) - 1)).astype(np.int64)
+        lone = b == (1 << B_BITS) - 1
+        # head: K-way select over row rolls up by c = a(u)
+        head = buf.copy()
+        for c in range(1, 1 << l):
+            if not (a == c).any():
+                continue
+            head = np.where((a == c)[:, None], _row_roll(buf, -c), head)
+        # tail: K-way select over row reads at offset o = b - 1
+        tail = np.zeros_like(buf)
+        for bv in range(0, (1 << B_BITS) - 1):
+            sel = (b == bv) & valid & ~lone
+            if not sel.any():
+                continue
+            tail = np.where(sel[:, None], _row_roll(buf, bv - 1), tail)
+        tail = _tail_lane_roll(tail, w, p, P)
+        out = head + np.where(lone[:, None], 0.0, tail)
+        buf = np.where(valid[:, None] & colmask, out, 0.0).astype(np.float32)
+
+    # spread phase: natural depth-(L-NL) nodes -> slot-SLOT_S container,
+    # one step = select over three static whole-array row rolls.
+    for j, A in enumerate(t.spread):
+        w = t.spread_words[j]
+        half = rows >> (j + 1)
+        sel = (w >> 22) & 3
+        valid = w < 0
+        out = buf
+        for sv, off in ((1, A - half), (2, A + 1 - half)):
+            if (sel == sv).any():
+                out = np.where((sel == sv)[:, None], _row_roll(buf, off), out)
+        buf = np.where(valid[:, None], out, 0.0).astype(np.float32)
+
+    # slot phase
+    for l in range(NL + 1, L + 1):
+        w = t.slot_words[l - NL - 1]
+        valid = w < 0
+        da = ((w >> A_SHIFT) & ((1 << A_BITS) - 1)).astype(np.int64)
+        db = ((w >> B_SHIFT) & ((1 << B_BITS) - 1)).astype(np.int64)
+        d = L - l
+        G = 1 << d
+        S_d = 1 << l
+        S_c = S_d >> 1
+        v = buf.reshape(G, 2, S_c, P)
+        heads, tails = v[:, 0], v[:, 1]
+        reph = np.repeat(heads, 2, axis=1)        # (G, S_d, P) interleaved
+        rept = np.repeat(tails, 2, axis=1)
+        da3 = da.reshape(G, S_d)
+        db3 = db.reshape(G, S_d)
+        head = np.zeros_like(reph)
+        tail = np.zeros_like(rept)
+        for dv in range(4):
+            delta = dv - 2
+            if (da3 == dv).any():
+                head = np.where((da3 == dv)[:, :, None], np.roll(reph, -delta, axis=1), head)
+            if (db3 == dv).any():
+                tail = np.where((db3 == dv)[:, :, None], np.roll(rept, -delta, axis=1), tail)
+        head = head.reshape(rows, P)
+        tail = tail.reshape(rows, P)
+        tail = _tail_lane_roll(tail, w, p, P)
+        out = head + tail
+        buf = np.where((w < 0)[:, None] & colmask, out, 0.0).astype(np.float32)
+
+    return buf[:m, :p]
