@@ -67,7 +67,11 @@ def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
         cpt = pltpu.make_async_copy(tab_hbm.at[b, lev], T, semt)
         cpt.start()
         cpt.wait()
-        return jnp.broadcast_to(T[:, :1], (rows, P))
+        # The words are lane-replicated in HBM; widen 128 -> P lanes with
+        # a tiled repeat (a width-1 lane slice + broadcast SIGABRTs the
+        # Mosaic compiler at rows >= 8 sublane tiles).
+        tv = T[:]
+        return tv if P == 128 else pltpu.repeat(tv, P // 128, axis=1)
 
     def tail_wrap(tail, sig, thr, nbits):
         for k in range(nbits):
@@ -147,16 +151,24 @@ def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
         cur = 1 - cur
 
     # ---- boxcar S/N -----------------------------------------------------
+    # Computed over the full 2**L row container (RS == rows): Mosaic
+    # SIGABRTs on any sublane slice of a VMEM scratch whose tile count
+    # differs from the allocation, so partial-row evaluation is done by
+    # the caller slicing the output instead. Padding rows are all-zero
+    # after the transform and produce S/N 0.
     src = bufs[cur]
-    xv = src[0:RS, :]
-    ccols = cols[0:RS, :]
+    xv = src[:]
+    ccols = cols
     cs = xv
     for k in range(9):
         if (1 << k) >= P:
             break
         sh = jnp.where(ccols >= (1 << k), pltpu.roll(cs, 1 << k, axis=1), 0.0)
         cs = cs + sh
-    total = jnp.broadcast_to(cs[:, P - 1 : P], (RS, P))
+    # Ring total per row as a lane reduction (xv is zero outside lanes
+    # [0, p)); avoids slicing lane P-1, which Mosaic cannot re-broadcast.
+    totc = jnp.sum(xv, axis=1, keepdims=True)
+    total = jnp.broadcast_to(totc, (RS, P))
     lanes = jax.lax.broadcasted_iota(jnp.int32, (RS, 128), 1)
     acc = jnp.zeros((RS, 128), jnp.float32)
     neg = jnp.float32(-3.0e38)
@@ -167,7 +179,7 @@ def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
         d = jnp.where(maskw, aw, bw + total) - cs
         d = jnp.where(ccols < p, d, neg)
         dmax = jnp.max(d, axis=1, keepdims=True)
-        snr_w = coef[b, iw] * dmax - coef[b, NWPAD + iw] * total[:, :1]
+        snr_w = coef[b, iw] * dmax - coef[b, NWPAD + iw] * totc
         acc = acc + jnp.where(lanes == iw, jnp.broadcast_to(snr_w, (RS, 128)), 0.0)
     out_ref[0] = acc
 
@@ -227,6 +239,10 @@ def _build_call(L, NL, rows, P, RS, widths, nspread, B, interpret):
         kern,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, RS, 128), jnp.float32),
+        # The unrolled select chains keep ~8 (rows, P) f32 temporaries
+        # live; at the deepest bucket (2048, 384) that exceeds the 16M
+        # default scoped-vmem limit. v5e has 128M VMEM per core.
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024),
         interpret=bool(interpret),
     )
     return jax.jit(call)
@@ -248,6 +264,22 @@ class CycleKernel:
                  interpret=False):
         ms = [int(m) for m in ms]
         ps = [int(p) for p in ps]
+        widths = tuple(int(w) for w in widths)
+        # The packed-word layout carries sigma/thr in 9-bit fields and the
+        # boxcar prefix scan covers a 512-lane window, so p is capped at
+        # 511 (callers fall back to the XLA gather path beyond it).
+        if max(ps) > 511:
+            raise ValueError(
+                f"CycleKernel supports p <= 511 (9-bit packed phase "
+                f"fields); got max p = {max(ps)}"
+            )
+        # One static width ladder serves the whole bucket: every width
+        # must be a valid trial for the smallest problem, mirroring the
+        # reference's check_trial_widths (riptide/cpp/snr.hpp:14-31).
+        if not widths or min(widths) < 1 or max(widths) >= min(ps):
+            raise ValueError("trial widths must satisfy 0 < w < min(p)")
+        if len(widths) > NWPAD:
+            raise ValueError(f"at most {NWPAD} trial widths supported")
         from .plan import num_levels
 
         Lmin = max(num_levels(m) for m in ms)
@@ -256,9 +288,12 @@ class CycleKernel:
         self.rows = rows = 1 << L
         pmax = max(ps)
         self.P = P = ((pmax + 127) // 128) * 128
-        mmax = max(ms)
-        self.RS = RS = min(rows, ((mmax + 7) // 8) * 8)
-        self.widths = widths = tuple(int(w) for w in widths)
+        # RS == rows always: Mosaic cannot compile sublane slices of the
+        # VMEM scratch at a smaller tile count (SIGABRT, `limits[i] <=
+        # dim(i)`), so the kernel evaluates S/N for every container row
+        # and callers slice the valid/evaluated prefix on the host side.
+        self.RS = RS = rows
+        self.widths = widths
         self.B = B = len(ms)
         self.nspread = L - NL
 

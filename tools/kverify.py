@@ -1,0 +1,54 @@
+"""Compiled-kernel vs oracle verification (run on the real TPU)."""
+import sys
+
+import numpy as np
+
+from riptide_tpu.ops.ffa_kernel import CycleKernel
+from riptide_tpu.ops.reference import boxcar_snr_2d, ffa_transform
+from riptide_tpu.ops.snr import boxcar_coeffs
+
+
+def run(ms, ps, widths=(1, 2, 3, 4, 6, 9, 13, 19, 28, 42), interpret=False, seed=0):
+    widths = tuple(w for w in widths if w < min(ps))
+    B = len(ms)
+    nw = len(widths)
+    h = np.zeros((B, nw), np.float32)
+    b = np.zeros((B, nw), np.float32)
+    for i, p in enumerate(ps):
+        h[i], b[i] = boxcar_coeffs(p, widths)
+    std = np.linspace(1.0, 2.0, B).astype(np.float32)
+    k = CycleKernel(ms, ps, widths, h, b, std, interpret=interpret)
+    rng = np.random.default_rng(seed)
+    x = np.zeros((B, k.rows, k.P), np.float32)
+    datas = []
+    for i, (m, p) in enumerate(zip(ms, ps)):
+        d = rng.standard_normal((m, p)).astype(np.float32)
+        datas.append(d)
+        x[i, :m, :p] = d
+    out = np.asarray(k(x))
+    worst = 0.0
+    for i, (m, p, d) in enumerate(zip(ms, ps, datas)):
+        tr = ffa_transform(d)
+        want = boxcar_snr_2d(tr, np.asarray(widths), stdnoise=float(std[i]))
+        got = out[i, :m, :nw]
+        err = np.abs(got - want)
+        rel = err / np.maximum(np.abs(want), 1.0)
+        worst = max(worst, float(rel.max()))
+        print(f"  m={m} p={p}: max abs err {err.max():.3e}  max rel err {rel.max():.3e}")
+    print("WORST_REL", worst)
+    return worst
+
+
+if __name__ == "__main__":
+    interp = "i" in sys.argv[1:]
+    pairs = [(100, 17), (250, 240), (1000, 250)]
+    if "prod" in sys.argv[1:]:
+        pairs = [(1046, 250), (1007, 260), (967, 241), (521, 257)]
+    if "bucket" in sys.argv[1:]:
+        # one bucket: same L, many p (like a real cascade cycle)
+        ms = [1046 - 4 * i for i in range(21)]
+        ps = list(range(240, 261))
+        run(ms, ps, interpret=interp)
+        sys.exit(0)
+    for m, p in pairs:
+        run([m], [p], interpret=interp)
