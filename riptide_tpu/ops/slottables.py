@@ -90,6 +90,11 @@ def _merge_tables(mn):
 def build_tables(m, p, L=None):
     """Build all kernel tables for one (m, p) problem at bucket depth L."""
     m, p = int(m), int(p)
+    if not 0 < p <= 511:
+        # sigma/thr live in 9-bit packed fields and the kernel's boxcar
+        # prefix scan covers a 512-lane window; beyond that the packed
+        # words silently truncate, so refuse loudly.
+        raise ValueError(f"packed-word layout requires 0 < p <= 511, got {p}")
     Lmin = num_levels(m)
     L = Lmin if L is None else int(L)
     assert L >= Lmin
@@ -309,10 +314,9 @@ def simulate_dense(data, L=None, P=None):
                 out = np.where((sel == sv)[:, None], _row_roll(buf, off), out)
         buf = np.where(valid[:, None], out, 0.0).astype(np.float32)
 
-    # slot phase
+    # slot phase (interleaved row-doubling, mirroring the kernel)
     for l in range(NL + 1, L + 1):
         w = t.slot_words[l - NL - 1]
-        valid = w < 0
         da = ((w >> A_SHIFT) & ((1 << A_BITS) - 1)).astype(np.int64)
         db = ((w >> B_SHIFT) & ((1 << B_BITS) - 1)).astype(np.int64)
         d = L - l

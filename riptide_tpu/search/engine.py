@@ -1,22 +1,29 @@
 """
 Device execution of a periodogram plan.
 
-Each cascade cycle runs as ONE jitted program over a padded
-(B, R, P) container (B = number of phase-bin trials of the cycle):
+Each cascade cycle runs as one or two device programs over a padded
+(B, R, P) container (B = number of phase-bin trials of the cycle). Two
+execution paths exist per stage:
 
-    downsample-by-gather -> pack rows -> FFA levels (scan) -> boxcar S/N
+* **kernel** (default on TPU): static pack (per-problem reshape + pad,
+  pure data movement) followed by the fused Pallas VMEM kernel of
+  :mod:`riptide_tpu.ops.ffa_kernel` — the whole FFA merge tree plus the
+  boxcar S/N runs without the container ever leaving VMEM.
+* **gather** (CPU / oracle / p > 511 fallback): the round-1 XLA
+  formulation — modular-gather FFA levels + gather-based S/N.
 
-The program is shape-polymorphic in everything data-like (level tables,
-downsample plans, coefficients are traced operands), so XLA compiles one
-kernel per padded-dimension bucket, not per cycle. A whole multi-DM batch
-runs the same program under ``jax.vmap``; sharding the DM axis over a
-device mesh (see :mod:`riptide_tpu.parallel`) distributes the batch with
-no code change here.
+Downsampling runs on the HOST in float64 (one prefix sum + weighted
+gathers per cascade cycle, mirroring the reference's double accumulator,
+riptide/cpp/downsample.hpp:44-82): a TPU-side gather of ~256k arbitrary
+indices lowers to a scalar loop and would dominate the search, while the
+host form is a handful of vectorised numpy passes overlapped with device
+compute. Select the path with RIPTIDE_FFA_PATH=auto|kernel|gather.
 
 Replaces the reference's single-threaded C++ search loop
 (riptide/cpp/periodogram.hpp:117-201) and its per-DM-trial OS process
 parallelism (riptide/pipeline/worker_pool.py) with one SPMD program.
 """
+import os
 from functools import partial
 
 import jax
@@ -25,6 +32,7 @@ import numpy as np
 
 from ..ops.downsample import downsample_gather, split_prefix_sums
 from ..ops.ffa import ffa_levels
+from ..ops.ffa_kernel import NWPAD
 from ..ops.snr import snr_batched
 
 __all__ = ["run_periodogram", "run_periodogram_batch", "cycle_fn"]
@@ -90,6 +98,108 @@ def cycle_fn_batch(x, cs_hi, cs_lo, ds, h, t, shift, p, m, hcoef, bcoef, stdnois
     return jax.vmap(one)(x, cs_hi, cs_lo)
 
 
+def _stage_downsample(st, d64, cs):
+    """One cascade stage's downsampling for a (..., N) float64 batch with
+    its precomputed (..., N + 1) fp64 prefix sums. Returns (..., nout)
+    float32. Mirrors the reference's always-from-the-original-series
+    semantics and double accumulator (riptide/cpp/downsample.hpp:44-82,
+    periodogram.hpp:162-168)."""
+    imin, imax, wmin, wmax, wint = st.ds_plan
+    acc = wmin * d64[..., imin]
+    acc += wint * (cs[..., imax] - cs[..., imin + 1])
+    acc += wmax * d64[..., imax]
+    return acc.astype(np.float32)
+
+
+def _prefix64(data):
+    data = np.asarray(data, dtype=np.float64)
+    cs = np.zeros(data.shape[:-1] + (data.shape[-1] + 1,), np.float64)
+    np.cumsum(data, axis=-1, out=cs[..., 1:])
+    return data, cs
+
+
+def host_downsample(plan, data):
+    """All cascade downsamplings of one series, on the host in float64.
+    Returns (num_stages, plan.nout) float32."""
+    d64, cs = _prefix64(data)
+    out = np.zeros((len(plan.stages), plan.nout), np.float32)
+    for i, st in enumerate(plan.stages):
+        out[i] = _stage_downsample(st, d64, cs)
+    return out
+
+
+@partial(jax.jit, static_argnames=("shapes", "rows", "P"))
+def _pack_static(xd, shapes, rows, P):
+    """
+    Static pack: per-problem reshape + zero-pad of a downsampled series
+    into the (..., B, rows, P) kernel container. Pure data movement (no
+    gather): problem b is xd[..., : m*p] viewed as (m, p) then padded.
+    """
+    outs = []
+    for m, p in shapes:
+        seg = xd[..., : m * p].reshape(xd.shape[:-1] + (m, p))
+        pad = [(0, 0)] * (seg.ndim - 2) + [(0, rows - m), (0, P - p)]
+        outs.append(jnp.pad(seg, pad))
+    return jnp.stack(outs, axis=-3)
+
+
+@partial(jax.jit, static_argnames=("widths", "P"))
+def _gather_cycle_xd(xd, h, t, shift, p, m, hcoef, bcoef, stdnoise, widths, P):
+    """Gather-path stage fed from a host-downsampled series; handles a
+    leading DM axis by vmap."""
+
+    def one(x1):
+        R = h.shape[2]
+        buf = _pack(x1, p, m, R, P)
+        tbuf = ffa_levels(buf, h, t, shift, p)
+        return snr_batched(tbuf, p, widths, hcoef, bcoef, stdnoise)
+
+    return jax.vmap(one)(xd) if xd.ndim == 2 else one(xd)
+
+
+def _ffa_path():
+    """'kernel' | 'gather', from RIPTIDE_FFA_PATH (auto = kernel on TPU
+    backends — incl. the axon tunnel — gather elsewhere: the Mosaic
+    kernel cannot lower on CPU/GPU)."""
+    mode = os.environ.get("RIPTIDE_FFA_PATH", "auto")
+    if mode in ("kernel", "gather"):
+        return mode
+    try:
+        tpu = jax.default_backend() in ("tpu", "axon")
+    except RuntimeError:
+        tpu = False
+    return "kernel" if tpu else "gather"
+
+
+def _kernel_eligible(st, plan):
+    """The fused Pallas kernel serves a stage when its packed-word layout
+    fits: p < 512, <= NWPAD widths, container of at least one sublane
+    tile. Ineligible stages fall back to the gather path per stage."""
+    return (
+        st.kernel_depth >= 3
+        and max(st.ps_padded) <= 511
+        and len(plan.widths) <= NWPAD
+    )
+
+
+def _run_stage(st, xd_dev, plan, path):
+    """Queue one cascade stage on device; returns the raw S/N container
+    (..., B, rows<=R, nw) as an unsynced device array."""
+    if path == "kernel" and _kernel_eligible(st, plan):
+        interpret = jax.default_backend() == "cpu"
+        kern = st.cycle_kernel(interpret=interpret)
+        x = _pack_static(xd_dev, tuple(zip(st.ms_padded, st.ps_padded)),
+                         kern.rows, kern.P)
+        out = kern(x)
+        return out[..., : max(st.rows_eval_max, 1), : len(plan.widths)]
+    ops = _stage_operands(st)
+    return _gather_cycle_xd(
+        xd_dev, ops["h"], ops["t"], ops["shift"], ops["p"], ops["m"],
+        ops["hcoef"], ops["bcoef"], ops["stdnoise"],
+        widths=plan.widths, P=plan.P,
+    )
+
+
 def _stage_operands(st):
     """Device operands of a CycleStage, memoized on the stage so repeated
     searches with a cached plan ship only the data, not the tables."""
@@ -140,20 +250,12 @@ def run_periodogram(plan, data):
     data = np.asarray(data, dtype=np.float32)
     if data.size != plan.size:
         raise ValueError("data length does not match plan size")
-    hi, lo = split_prefix_sums(data)
-    x = jnp.asarray(data)
-    cs_hi = jnp.asarray(hi)
-    cs_lo = jnp.asarray(lo)
-    outs = []
-    for st in plan.stages:
-        ops = _stage_operands(st)
-        outs.append(
-            cycle_fn(
-                x, cs_hi, cs_lo, ops["ds"], ops["h"], ops["t"], ops["shift"],
-                ops["p"], ops["m"], ops["hcoef"], ops["bcoef"], ops["stdnoise"],
-                widths=plan.widths, P=plan.P,
-            )
-        )
+    path = _ffa_path()
+    xds = host_downsample(plan, data)
+    outs = [
+        _run_stage(st, jnp.asarray(xds[i]), plan, path)
+        for i, st in enumerate(plan.stages)
+    ]
     # One host sync at the end: device work for all cycles is queued
     # asynchronously, then gathered.
     raw = [np.asarray(o) for o in outs]
@@ -181,19 +283,22 @@ def run_periodogram_batch(plan, batch):
 
     Returns (periods, foldbins, snrs (D, len, NW)).
     """
-    x, cs_hi, cs_lo = prepare_batch(plan, batch)
+    batch = np.asarray(batch, dtype=np.float32)
+    if batch.ndim != 2 or batch.shape[1] != plan.size:
+        raise ValueError("batch must be (D, N) with N matching the plan")
+    D = batch.shape[0]
+    path = _ffa_path()
+    # Stage-wise: downsample stage i for the whole batch on the host,
+    # ship it, queue the device stage, then move to stage i+1 — so host
+    # prep of later stages genuinely overlaps device execution of
+    # earlier ones (device calls are asynchronous).
+    d64, cs = _prefix64(batch)
     outs = []
     for st in plan.stages:
-        ops = _stage_operands(st)
-        outs.append(
-            cycle_fn_batch(
-                x, cs_hi, cs_lo, ops["ds"], ops["h"], ops["t"], ops["shift"],
-                ops["p"], ops["m"], ops["hcoef"], ops["bcoef"], ops["stdnoise"],
-                widths=plan.widths, P=plan.P,
-            )
-        )
-    raw = [np.asarray(o) for o in outs]  # (D, B, R, NW) each
+        xd = jnp.asarray(_stage_downsample(st, d64, cs))
+        outs.append(_run_stage(st, xd, plan, path))
+    raw = [np.asarray(o) for o in outs]  # (D, B, rows<=R, NW) each
     snrs = np.stack(
-        [_assemble(plan, [r[d] for r in raw]) for d in range(x.shape[0])]
+        [_assemble(plan, [r[d] for r in raw]) for d in range(D)]
     )
     return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs

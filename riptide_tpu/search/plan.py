@@ -108,18 +108,15 @@ class CycleStage:
         # Dummy problems have m = 1 / rows_eval = 0 and are never read back.
         B = bins_max - bins_min + 1
         pad = B - len(self.bins)
-        ms_padded = ms + [1] * pad
-        ps_padded = self.bins + [bins_min] * pad
+        self.ms_padded = ms + [1] * pad
+        self.ps_padded = self.bins + [bins_min] * pad
         stds = np.asarray(ms, np.float64) * var
         self.stdnoise = np.sqrt(
             np.concatenate([stds, np.ones(pad)])
         ).astype(np.float32)
+        self.widths = widths
+        self.rows_eval_max = max(self.rows_eval) if self.rows_eval else 0
 
-        R = _round_bucket(max(ms) + 1)
-        # L tied to the R bucket => one compiled kernel per bucket.
-        self.batch = FFABatchPlan(
-            ms_padded, ps_padded, R=R, P=bins_max, L=int(math.ceil(math.log2(R)))
-        )
         nw = len(widths)
         self.hcoef = np.zeros((B, nw), np.float32)
         self.bcoef = np.zeros((B, nw), np.float32)
@@ -129,6 +126,44 @@ class CycleStage:
 
         self.ds_plan = downsample_plan_padded(size, f, nout)
         self.length = sum(self.rows_eval)
+
+    # Both executable forms of the stage are built lazily so a search
+    # only pays for the path it runs (the Pallas tables and the gather
+    # tables are each a few MB of host work per stage).
+
+    @property
+    def batch(self):
+        """Gather-path :class:`FFABatchPlan` (XLA fallback / CPU oracle)."""
+        b = getattr(self, "_batch", None)
+        if b is None:
+            R = _round_bucket(max(m for m in self.ms_padded) + 1)
+            b = FFABatchPlan(
+                self.ms_padded, self.ps_padded, R=R, P=max(self.ps_padded),
+                L=int(math.ceil(math.log2(R))),
+            )
+            self._batch = b
+        return b
+
+    @property
+    def kernel_depth(self):
+        """Pallas bucket depth: ceil(log2(max m)) over the stage."""
+        from ..ops.plan import num_levels
+
+        return max(num_levels(m) for m in self.ms_padded)
+
+    def cycle_kernel(self, interpret=False):
+        """Lazily-built fused Pallas :class:`CycleKernel` for this stage."""
+        k = getattr(self, "_cycle_kernel", None)
+        if k is None or k.interpret != bool(interpret):
+            from ..ops.ffa_kernel import CycleKernel
+
+            k = CycleKernel(
+                self.ms_padded, self.ps_padded, self.widths, self.hcoef,
+                self.bcoef, self.stdnoise, L=self.kernel_depth,
+                interpret=interpret,
+            )
+            self._cycle_kernel = k
+        return k
 
 
 class PeriodogramPlan:

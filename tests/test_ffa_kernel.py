@@ -1,0 +1,157 @@
+"""
+Correctness chain of the fused Pallas FFA/S-N kernel stack:
+
+    oracle (ops.reference.ffa_transform, parity-tested against the
+    reference recursion riptide/cpp/transforms.hpp:30-50)
+      == slot_transform_np      (slot-layout index algebra)
+      == simulate_dense         (the kernel's exact dense-op sequence)
+      == CycleKernel(interpret) (Pallas kernel, interpret mode)
+
+plus engine-level parity of the kernel path against the gather path.
+Compiled-vs-oracle verification at production shapes runs on the real
+chip via tools/kverify.py (the suite forces the CPU backend).
+"""
+import numpy as np
+import pytest
+
+from riptide_tpu.ops.ffa_kernel import CycleKernel, NWPAD
+from riptide_tpu.ops.reference import boxcar_snr_2d, ffa_transform
+from riptide_tpu.ops.slotffa import slot_transform_np
+from riptide_tpu.ops.slottables import build_tables, simulate_dense
+from riptide_tpu.ops.snr import boxcar_coeffs
+
+# Non-power-of-2 m, m below/above slot thresholds, p > 128, p not a
+# multiple of anything convenient.
+SHAPES = [(2, 8), (5, 7), (8, 16), (12, 17), (16, 16), (37, 33),
+          (100, 130), (121, 240), (250, 251)]
+
+
+@pytest.mark.parametrize("m,p", SHAPES)
+def test_slot_transform_matches_oracle(m, p):
+    rng = np.random.default_rng(m * 1000 + p)
+    data = rng.standard_normal((m, p)).astype(np.float32)
+    np.testing.assert_array_equal(slot_transform_np(data), ffa_transform(data))
+
+
+@pytest.mark.parametrize("m,p", SHAPES)
+def test_simulate_dense_matches_oracle(m, p):
+    rng = np.random.default_rng(m * 1000 + p)
+    data = rng.standard_normal((m, p)).astype(np.float32)
+    np.testing.assert_array_equal(simulate_dense(data), ffa_transform(data))
+
+
+@pytest.mark.parametrize("m,p", [(13, 16), (100, 130)])
+def test_simulate_dense_padded_bucket(m, p):
+    """Deeper bucket (L > ceil(log2 m)) and lane padding P > p."""
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((m, p)).astype(np.float32)
+    L = int(np.ceil(np.log2(m))) + 1
+    P = ((p + 127) // 128) * 128
+    np.testing.assert_array_equal(simulate_dense(data, L=L, P=P),
+                                  ffa_transform(data))
+
+
+def _kernel_case(ms, ps, widths, seed=0):
+    widths = tuple(w for w in widths if w < min(ps))
+    B, nw = len(ms), len(widths)
+    h = np.zeros((B, nw), np.float32)
+    b = np.zeros((B, nw), np.float32)
+    for i, p in enumerate(ps):
+        h[i], b[i] = boxcar_coeffs(p, widths)
+    std = np.linspace(1.0, 2.0, B).astype(np.float32)
+    k = CycleKernel(ms, ps, widths, h, b, std, interpret=True)
+    rng = np.random.default_rng(seed)
+    x = np.zeros((B, k.rows, k.P), np.float32)
+    datas = []
+    for i, (m, p) in enumerate(zip(ms, ps)):
+        d = rng.standard_normal((m, p)).astype(np.float32)
+        datas.append(d)
+        x[i, :m, :p] = d
+    return k, x, datas, widths, std
+
+
+def _check_kernel(k, out, ms, ps, datas, widths, std):
+    nw = len(widths)
+    for i, (m, p, d) in enumerate(zip(ms, ps, datas)):
+        if m == 1:
+            continue  # padding problem, never read back
+        want = boxcar_snr_2d(ffa_transform(d), np.asarray(widths),
+                             stdnoise=float(std[i]))
+        got = np.asarray(out)[i, :m, :nw]
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("ms,ps", [
+    ([16], [16]),                      # power-of-2 minimum
+    ([100], [130]),                    # p > 128 (two lane tiles)
+    ([37, 29, 1], [33, 40, 33]),       # mixed bucket incl. m=1 padding
+    ([250, 240, 230], [240, 250, 260]),  # production-style bins trial batch
+])
+def test_cycle_kernel_interpret_matches_oracle(ms, ps):
+    widths = (1, 2, 3, 4, 6, 9, 13)
+    k, x, datas, widths, std = _kernel_case(ms, ps, widths)
+    out = k(x)
+    _check_kernel(k, out, ms, ps, datas, widths, std)
+
+
+def test_cycle_kernel_dm_batch_axis():
+    """(D, B, rows, P) input: every DM trial matches its own oracle."""
+    ms, ps = [37, 29], [33, 40]
+    widths = (1, 2, 3, 5)
+    k, x0, _, widths, std = _kernel_case(ms, ps, widths)
+    rng = np.random.default_rng(7)
+    D = 3
+    x = np.zeros((D,) + x0.shape, np.float32)
+    datas = [[rng.standard_normal((m, p)).astype(np.float32)
+              for m, p in zip(ms, ps)] for _ in range(D)]
+    for d in range(D):
+        for i, (m, p) in enumerate(zip(ms, ps)):
+            x[d, i, :m, :p] = datas[d][i]
+    out = np.asarray(k(x))
+    assert out.shape[:2] == (D, len(ms))
+    for d in range(D):
+        _check_kernel(k, out[d], ms, ps, datas[d], widths, std)
+
+
+def test_cycle_kernel_validation():
+    h = np.ones((1, 2), np.float32)
+    b = np.ones((1, 2), np.float32)
+    std = np.ones(1, np.float32)
+    with pytest.raises(ValueError, match="p <= 511"):
+        CycleKernel([100], [600], (1, 2), h, b, std)
+    with pytest.raises(ValueError, match="p <= 511"):
+        build_tables(100, 600)
+    with pytest.raises(ValueError, match="widths"):
+        CycleKernel([100], [64], (1, 64), h, b, std)  # w >= min(p)
+    many = tuple(range(1, NWPAD + 2))
+    hh = np.ones((1, len(many)), np.float32)
+    with pytest.raises(ValueError, match="widths"):
+        CycleKernel([100], [64], many, hh, hh, std)
+
+
+def test_engine_kernel_path_parity(monkeypatch):
+    """Full periodogram: kernel path == gather path on a multi-stage plan
+    (and therefore == the numpy oracle, which the gather path is tested
+    against in test_search.py)."""
+    from riptide_tpu.search.engine import run_periodogram, run_periodogram_batch
+    from riptide_tpu.search.plan import periodogram_plan
+
+    plan = periodogram_plan(4096, 1e-3, (1, 2, 3), 64e-3, 0.15, 64, 71)
+    assert any(st.kernel_depth >= 3 for st in plan.stages)
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal(4096).astype(np.float32)
+
+    monkeypatch.setenv("RIPTIDE_FFA_PATH", "gather")
+    pg, fg, sg = run_periodogram(plan, data)
+    monkeypatch.setenv("RIPTIDE_FFA_PATH", "kernel")
+    pk, fk, sk = run_periodogram(plan, data)
+
+    np.testing.assert_array_equal(pg, pk)
+    np.testing.assert_array_equal(fg, fk)
+    np.testing.assert_allclose(sk, sg, rtol=2e-4, atol=2e-4)
+
+    batch = rng.standard_normal((2, 4096)).astype(np.float32)
+    _, _, sbk = run_periodogram_batch(plan, batch)
+    monkeypatch.setenv("RIPTIDE_FFA_PATH", "gather")
+    _, _, sbg = run_periodogram_batch(plan, batch)
+    np.testing.assert_allclose(sbk, sbg, rtol=2e-4, atol=2e-4)

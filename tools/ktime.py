@@ -1,0 +1,57 @@
+"""Time the compiled CycleKernel on the real TPU at production shapes."""
+import sys
+import time
+
+import jax
+import numpy as np
+
+from riptide_tpu.ops.ffa_kernel import CycleKernel
+from riptide_tpu.ops.snr import boxcar_coeffs
+
+
+def run(ms, ps, widths=(1, 2, 3, 4, 6, 9, 13, 19, 28, 42), reps=10):
+    widths = tuple(w for w in widths if w < min(ps))
+    B = len(ms)
+    nw = len(widths)
+    h = np.zeros((B, nw), np.float32)
+    b = np.zeros((B, nw), np.float32)
+    for i, p in enumerate(ps):
+        h[i], b[i] = boxcar_coeffs(p, widths)
+    std = np.ones(B, np.float32)
+    k = CycleKernel(ms, ps, widths, h, b, std)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((B, k.rows, k.P)).astype(np.float32)
+    import jax.numpy as jnp
+
+    xd = jax.device_put(x)
+    # Warm up + true sync (block_until_ready does not sync under the
+    # axon tunnel; only a real device->host fetch does).
+    t0 = time.perf_counter()
+    float(np.asarray(k(xd)[0, 0, 0]))
+    print(f"  warmup (compile): {time.perf_counter()-t0:.1f}s", flush=True)
+
+    def run(reps):
+        t0 = time.perf_counter()
+        vals = [k(xd)[0, 0, 0] for _ in range(reps)]
+        s = float(np.asarray(jnp.stack(vals)).sum())  # ONE fetch
+        assert np.isfinite(s)
+        dt = time.perf_counter() - t0
+        print(f"  run({reps}): {dt:.3f}s", flush=True)
+        return dt
+
+    r1, r2 = 2, 2 + reps
+    t1 = min(run(r1) for _ in range(2))
+    t2 = min(run(r2) for _ in range(2))
+    dt = (t2 - t1) / (r2 - r1)
+    adds = sum(m * p * np.ceil(np.log2(max(m, 2))) for m, p in zip(ms, ps))
+    print(
+        f"bucket B={B} rows={k.rows} P={k.P}: {dt*1e3:.2f} ms/call "
+        f"({adds/1e6:.0f} M useful adds, {adds/dt/1e9:.1f} G adds/s)"
+    )
+    return dt
+
+
+if __name__ == "__main__":
+    ms = [1046 - 4 * i for i in range(21)]
+    ps = list(range(240, 261))
+    run(ms, ps)

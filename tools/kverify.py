@@ -1,5 +1,6 @@
-"""Compiled-kernel vs oracle verification (run on the real TPU)."""
+"""Compiled-kernel vs oracle verification + timing (run on the real TPU)."""
 import sys
+import time
 
 import numpy as np
 
@@ -8,7 +9,7 @@ from riptide_tpu.ops.reference import boxcar_snr_2d, ffa_transform
 from riptide_tpu.ops.snr import boxcar_coeffs
 
 
-def run(ms, ps, widths=(1, 2, 3, 4, 6, 9, 13, 19, 28, 42), interpret=False, seed=0):
+def setup(ms, ps, widths, interpret=False):
     widths = tuple(w for w in widths if w < min(ps))
     B = len(ms)
     nw = len(widths)
@@ -17,14 +18,25 @@ def run(ms, ps, widths=(1, 2, 3, 4, 6, 9, 13, 19, 28, 42), interpret=False, seed
     for i, p in enumerate(ps):
         h[i], b[i] = boxcar_coeffs(p, widths)
     std = np.linspace(1.0, 2.0, B).astype(np.float32)
-    k = CycleKernel(ms, ps, widths, h, b, std, interpret=interpret)
+    return CycleKernel(ms, ps, widths, h, b, std, interpret=interpret), widths, std
+
+
+def fill(k, ms, ps, seed=0):
     rng = np.random.default_rng(seed)
-    x = np.zeros((B, k.rows, k.P), np.float32)
+    x = np.zeros((len(ms), k.rows, k.P), np.float32)
     datas = []
     for i, (m, p) in enumerate(zip(ms, ps)):
         d = rng.standard_normal((m, p)).astype(np.float32)
         datas.append(d)
         x[i, :m, :p] = d
+    return x, datas
+
+
+def run(ms, ps, widths=(1, 2, 3, 4, 6, 9, 13, 19, 28, 42), interpret=False,
+        seed=0, kernel=None):
+    k, widths, std = (kernel if kernel else setup(ms, ps, widths, interpret))
+    nw = len(widths)
+    x, datas = fill(k, ms, ps, seed)
     out = np.asarray(k(x))
     worst = 0.0
     for i, (m, p, d) in enumerate(zip(ms, ps, datas)):
@@ -39,16 +51,46 @@ def run(ms, ps, widths=(1, 2, 3, 4, 6, 9, 13, 19, 28, 42), interpret=False, seed
     return worst
 
 
+def timed(ms, ps, widths=(1, 2, 3, 4, 6, 9, 13, 19, 28, 42), reps=10, seed=0):
+    """Verify, then time with the slope method (one fetch per run --
+    block_until_ready does not synchronize under the axon tunnel)."""
+    import jax
+    import jax.numpy as jnp
+
+    bundle = setup(ms, ps, widths)
+    worst = run(ms, ps, seed=seed, kernel=bundle)
+    k = bundle[0]
+    x, _ = fill(k, ms, ps, seed)
+    xd = jax.device_put(x)
+    float(np.asarray(k(xd)[0, 0, 0]))  # warm
+
+    def go(n):
+        t0 = time.perf_counter()
+        vals = [k(xd)[0, 0, 0] for _ in range(n)]
+        assert np.isfinite(float(np.asarray(jnp.stack(vals)).sum()))
+        return time.perf_counter() - t0
+
+    t1 = min(go(2) for _ in range(2))
+    t2 = min(go(2 + reps) for _ in range(2))
+    dt = (t2 - t1) / reps
+    print(f"TIMED bucket B={len(ms)} rows={k.rows} P={k.P}: {dt*1e3:.2f} ms/call "
+          f"(worst rel err {worst:.2e})")
+    return dt
+
+
 if __name__ == "__main__":
     interp = "i" in sys.argv[1:]
-    pairs = [(100, 17), (250, 240), (1000, 250)]
-    if "prod" in sys.argv[1:]:
-        pairs = [(1046, 250), (1007, 260), (967, 241), (521, 257)]
     if "bucket" in sys.argv[1:]:
         # one bucket: same L, many p (like a real cascade cycle)
         ms = [1046 - 4 * i for i in range(21)]
         ps = list(range(240, 261))
-        run(ms, ps, interpret=interp)
+        if "t" in sys.argv[1:]:
+            timed(ms, ps)
+        else:
+            run(ms, ps, interpret=interp)
         sys.exit(0)
+    pairs = [(100, 17), (250, 240), (1000, 250)]
+    if "prod" in sys.argv[1:]:
+        pairs = [(1046, 250), (1007, 260), (967, 241), (521, 257)]
     for m, p in pairs:
         run([m], [p], interpret=interp)

@@ -1,0 +1,247 @@
+"""Microbenchmark Pallas primitive passes on the real chip.
+
+Times N repetitions of one primitive pattern over a (2048, 384) f32 VMEM
+buffer inside a single-program pallas kernel, to locate the slow ops in
+the fused FFA kernel (which is built from exactly these patterns).
+"""
+import functools
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/riptide_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROWS, P = 2048, 384
+REPS = 32
+
+
+def kern_roll(x_ref, o_ref):
+    x = x_ref[:]
+    acc = x
+    for i in range(REPS):
+        acc = acc + pltpu.roll(x, (i * 7 + 1) % P, axis=1)
+    o_ref[:] = acc
+
+
+def kern_roll_rows(x_ref, o_ref):
+    x = x_ref[:]
+    acc = x
+    for i in range(REPS):
+        acc = acc + pltpu.roll(x, (i * 5 + 1) % ROWS, axis=0)
+    o_ref[:] = acc
+
+
+def kern_select(x_ref, o_ref):
+    x = x_ref[:]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (ROWS, P), 1)
+    acc = x
+    for i in range(REPS):
+        acc = jnp.where(cols < (i * 11) % P, acc + 1.0, acc * 0.5)
+    o_ref[:] = acc
+
+
+def kern_barrel(x_ref, o_ref):
+    x = x_ref[:]
+    sig = jax.lax.broadcasted_iota(jnp.int32, (ROWS, P), 0)
+    acc = x
+    for k in range(min(REPS, 9)):
+        rolled = pltpu.roll(acc, 1 << k, axis=1)
+        acc = jnp.where(((sig >> k) & 1) != 0, rolled, acc)
+    o_ref[:] = acc
+
+
+def kern_interleave(x_ref, o_ref):
+    x = x_ref[:]
+    G, S_d = 8, ROWS // 8
+    acc = x
+    for i in range(max(REPS // 8, 1)):
+        v = acc.reshape(G, 2, S_d // 2, P)
+        reph = jnp.repeat(v[:, 0], 2, axis=1)
+        rept = jnp.repeat(v[:, 1], 2, axis=1)
+        acc = (reph + rept).reshape(ROWS, P) + float(i)
+    o_ref[:] = acc
+
+
+def kern_dynroll(s_ref, x_ref, o_ref):
+    x = x_ref[:]
+    acc = x
+    for i in range(REPS):
+        acc = acc + pltpu.roll(x, s_ref[i % 8], axis=0)
+    o_ref[:] = acc
+
+
+def build(kern, with_scal=False, shape=(ROWS, P)):
+    in_specs = [pl.BlockSpec(memory_space=pltpu.VMEM)]
+    if with_scal:
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
+    return jax.jit(pl.pallas_call(
+        kern,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(shape, jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024
+        ),
+    ))
+
+
+def make_tile_add(rows, cols):
+    def kern(x_ref, o_ref):
+        x = x_ref[:]
+        acc = x
+        for i in range(REPS):
+            acc = acc * 1.0001 + x
+        o_ref[:] = acc
+    return kern
+
+
+def make_tile_roll(rows, cols):
+    def kern(x_ref, o_ref):
+        x = x_ref[:]
+        acc = x
+        for i in range(REPS):
+            acc = acc + pltpu.roll(x, (i * 7 + 1) % cols, axis=1)
+        o_ref[:] = acc
+    return kern
+
+
+def _run_k(fn, args, k):
+    """k sequential device calls, ONE host sync at the end."""
+    t0 = time.perf_counter()
+    vals = [fn(*args)[0, 0] for _ in range(k)]
+    np.asarray(jnp.stack(vals))
+    return time.perf_counter() - t0
+
+
+def timeit(name, fn, args, passes, k1=4, k2=16):
+    fn(*args).block_until_ready()
+    # slope method: (k2 calls + sync) - (k1 calls + sync) removes the
+    # (wildly variable) tunnel roundtrip latency from the estimate.
+    t1 = min(_run_k(fn, args, k1) for _ in range(3))
+    t2 = min(_run_k(fn, args, k2) for _ in range(3))
+    dt = (t2 - t1) / (k2 - k1)
+    print(f"{name:12s}: {dt*1e3:8.3f} ms/call  {dt/passes*1e6:8.1f} us/pass"
+          f"  ({passes} passes)")
+    return dt
+
+
+def kern_add(x_ref, o_ref):
+    x = x_ref[:]
+    acc = x
+    for i in range(REPS):
+        acc = acc * 1.0001 + x
+    o_ref[:] = acc
+
+
+def kern_repeat_tpu(x_ref, o_ref):
+    x = x_ref[:]
+    G, S_d = 8, ROWS // 8
+    acc = x
+    for i in range(max(REPS // 8, 1)):
+        v = acc.reshape(G, 2, S_d // 2, P)
+        reph = pltpu.repeat(v[:, 0], 2, axis=1)
+        rept = pltpu.repeat(v[:, 1], 2, axis=1)
+        acc = (reph + rept).reshape(ROWS, P) + float(i)
+    o_ref[:] = acc
+
+
+def kern_repeat_flat(x_ref, o_ref):
+    """Interleave via 2-D ops only: shift + parity select (no reshape)."""
+    x = x_ref[:]
+    rows2 = jax.lax.broadcasted_iota(jnp.int32, (ROWS, P), 0)
+    acc = x
+    for i in range(max(REPS // 4, 1)):
+        # repeat-each-row-twice approximation pattern: out[u] = acc[u//2 + base]
+        # expressed as two strided-ish selects over static rolls
+        up1 = pltpu.roll(acc, 1, axis=0)
+        acc = jnp.where((rows2 & 1) == 0, acc, up1) + float(i)
+    o_ref[:] = acc
+
+
+def kern_stride_roll(x_ref, o_ref):
+    x = x_ref[:]
+    acc = x
+    for i in range(REPS):
+        acc = acc + pltpu.roll(x, i % P, axis=1, stride=1, stride_axis=0)
+    o_ref[:] = acc
+
+
+def kern_matmul(a_ref, x_ref, o_ref):
+    a = a_ref[:]   # (ROWS, ROWS) selection-ish matrix
+    x = x_ref[:]
+    acc = x
+    for i in range(max(REPS // 8, 1)):
+        acc = jax.lax.dot_general(
+            a, acc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * 0.001 + float(i)
+    o_ref[:] = acc
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((ROWS, P)).astype(np.float32))
+    scal = jnp.asarray(np.arange(8, dtype=np.int32) * 37 + 5)
+
+    null = jax.jit(lambda a: a * 1.0)
+    null(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(8):
+        float(np.asarray(null(x)[0, 0]))
+    rt = (time.perf_counter() - t0) / 8
+    print(f"{'sync RT':12s}: {rt*1e3:8.2f} ms/call  (baseline)")
+
+    which = sys.argv[1:] or ["all"]
+
+    def want(n):
+        return "all" in which or n in which
+
+    if want("add"):
+        timeit("add", build(kern_add), (x,), REPS)
+    if want("roll"):
+        timeit("roll lanes", build(kern_roll), (x,), REPS)
+        timeit("roll rows", build(kern_roll_rows), (x,), REPS)
+    if want("select"):
+        timeit("select", build(kern_select), (x,), REPS)
+    if want("barrel"):
+        timeit("barrel9", build(kern_barrel), (x,), 9)
+    if want("inter"):
+        timeit("interleave", build(kern_interleave), (x,), REPS // 8)
+        timeit("repeat_tpu", build(kern_repeat_tpu), (x,), REPS // 8)
+        timeit("parity_sel", build(kern_repeat_flat), (x,), REPS // 4)
+    if want("dyn"):
+        timeit("dynroll", build(kern_dynroll, with_scal=True), (scal, x), REPS)
+    if want("stride"):
+        timeit("stride_roll", build(kern_stride_roll), (x,), REPS)
+    if want("tile"):
+        for rows, cols in [(64, 384), (256, 384), (512, 384), (2048, 384),
+                           (2048, 128), (256, 128), (8, 384), (8, 128)]:
+            xt = jnp.asarray(
+                rng.standard_normal((rows, cols)).astype(np.float32))
+            ksz = rows * cols
+            dt = timeit(f"add {rows}x{cols}",
+                        build(make_tile_add(rows, cols), shape=(rows, cols)),
+                        (xt,), REPS)
+            print(f"    -> {ksz*REPS/dt/1e9:.1f} Gelem/s")
+            dt = timeit(f"roll {rows}x{cols}",
+                        build(make_tile_roll(rows, cols), shape=(rows, cols)),
+                        (xt,), REPS)
+            print(f"    -> {ksz*REPS/dt/1e9:.1f} Gelem/s")
+    if want("mm"):
+        a = jnp.asarray(rng.standard_normal((ROWS, ROWS)).astype(np.float32))
+        mm = build(kern_matmul)
+        n = max(REPS // 8, 1)
+        dt = timeit("matmul", mm, (a, x), n)
+        fl = 2.0 * ROWS * ROWS * P * n
+        print(f"  -> {fl/dt/1e12:.1f} TFLOP/s f32 ({ROWS}x{ROWS}x{P})")
+
+
+if __name__ == "__main__":
+    main()
