@@ -19,7 +19,7 @@ Multi-host: :func:`init_distributed` wraps ``jax.distributed.initialize``;
 all collectives ride XLA over ICI/DCN.
 """
 from .mesh import default_mesh, mesh_2d
-from .sharded import run_periodogram_sharded
+from .sharded import run_periodogram_sharded, run_search_sharded
 from .seqffa import ffa2_seq, seq_mesh
 from .distributed import init_distributed
 
@@ -27,6 +27,7 @@ __all__ = [
     "default_mesh",
     "mesh_2d",
     "run_periodogram_sharded",
+    "run_search_sharded",
     "ffa2_seq",
     "seq_mesh",
     "init_distributed",

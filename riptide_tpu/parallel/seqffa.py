@@ -17,6 +17,21 @@ across shards. Those cross levels run as ``all_gather`` over the ICI ring
 followed by a local gather+roll+add of each shard's output rows, so
 compute stays fully sharded and only the folded buffer (m x p floats per
 level) rides the interconnect.
+
+**Scope — a deliberate demo of the decomposition, not a production
+path.** Sizing: the flagship survey config folds 2^23-sample series —
+32 MB of float32 — and the largest per-cycle fold container is
+(2048 rows x 384 padded bins x 21 bins-trials x 4 B) ~ 66 MB, against
+16 GB of HBM per v5e chip: real searches are ~200x below the point
+where one transform must span chips, which is why the production layout
+(:mod:`riptide_tpu.parallel.sharded`) shards the DM batch and keeps
+every series chip-local (SURVEY §5 long-context analysis reaches the
+same conclusion). The per-level full ``all_gather`` here moves
+log2(S) * m * p floats per shard where a windowed pairwise exchange
+would move (m/S) * log2(S); acceptable for a demo, wasteful at scale —
+if observations ever outgrow HBM, replace the gather with per-level
+``ppermute`` of the two ~m_local/2-row source windows each shard's
+outputs actually read (the h/t tables below already bound them).
 """
 from functools import lru_cache
 

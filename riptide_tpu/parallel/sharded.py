@@ -1,78 +1,116 @@
 """
 Mesh-sharded periodogram execution.
 
-``run_periodogram_sharded`` is the distributed counterpart of
-:func:`riptide_tpu.search.engine.run_periodogram_batch`: the same
-per-cycle program, wrapped in ``jax.shard_map`` so the DM axis of the
-batch is split over the ``dm`` axis of a device mesh (and, optionally,
-each cycle's phase-bin-trial batch over a ``bins`` axis). Every shard of
-work is independent — the SPMD program contains no collectives; the only
-communication is the final gather of the (D, trials, widths) S/N stack,
-mirroring the reference's design where workers return only tiny peak
-lists (riptide/pipeline/worker_pool.py:47-71, CHANGELOG 0.1.4).
-"""
-from functools import lru_cache
+Two distributed entry points:
 
+* :func:`run_periodogram_sharded` — the distributed counterpart of
+  ``run_periodogram_batch``: per-cycle stage programs wrapped in
+  ``jax.shard_map`` so the DM axis splits over the ``dm`` mesh axis
+  (and, for the XLA gather path, the phase-bin-trial batch over an
+  optional ``bins`` axis). Returns the full S/N cube — use it when the
+  periodogram itself is the product.
+* :func:`run_search_sharded` — the survey path (SURVEY §2c/§5): the S/N
+  cube stays device-resident and dm-sharded; peak detection runs on
+  device, and only fixed-size (trial index, S/N) peak buffers — a few
+  KB per DM trial — are gathered to the host, mirroring the reference's
+  tiny-pickled-Peaks worker contract
+  (riptide/pipeline/worker_pool.py:47-71, CHANGELOG 0.1.4).
+
+Every shard of stage work is independent — the SPMD programs contain no
+collectives; the Pallas cycle kernel runs per-shard inside shard_map on
+its local (D/n_dm, B) grid. The bins axis is only supported on the
+gather path (the fused kernel serves a full bins-trial bucket per
+program); a bins-sharded mesh falls back to the gather path per stage.
+"""
 import numpy as np
 import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as Pspec
 
-from ..search.engine import _cycle_impl, _stage_operands, _assemble, prepare_batch
+from ..search.engine import (
+    _assemble,
+    _assemble_device,
+    _ffa_path,
+    _kernel_eligible,
+    _pack_static,
+    _prefix64,
+    _stage_downsample,
+    _stage_operands,
+    _wire_dtype,
+)
 
-__all__ = ["run_periodogram_sharded"]
+__all__ = ["run_periodogram_sharded", "run_search_sharded"]
 
 
-@lru_cache(maxsize=32)
-def _sharded_cycle(mesh, widths, P, with_bins_axis):
-    """Build + jit the shard-mapped cycle program for one mesh layout."""
+def _stage_sharded_call(mesh, st, plan, path, with_bins):
+    """Build (and cache on the stage) the shard_mapped program for one
+    cascade stage on one mesh layout."""
+    cache = getattr(st, "_sharded_calls", None)
+    if cache is None:
+        cache = st._sharded_calls = {}
+    key = (mesh, path, with_bins)
+    fn = cache.get(key)
+    if fn is not None:
+        return fn
+
     dm = Pspec("dm")
-    b = "bins" if with_bins_axis else None
-    rep = Pspec()
-    in_specs = (
-        dm, dm, dm,                                   # x, cs_hi, cs_lo
-        (rep, rep, rep, rep, rep),                    # downsample plan
-        Pspec(None, b, None),                         # h
-        Pspec(None, b, None),                         # t
-        Pspec(None, b, None),                         # shift
-        Pspec(b), Pspec(b),                           # p, m
-        Pspec(b, None), Pspec(b, None),               # hcoef, bcoef
-        Pspec(b),                                     # stdnoise
+    use_kernel = (
+        path == "kernel" and not with_bins and _kernel_eligible(st, plan)
     )
-    out_specs = Pspec("dm", b, None, None)
+    if use_kernel:
+        # interpret mode on CPU backends (virtual test meshes), like the
+        # unsharded engine path.
+        kern = st.cycle_kernel(interpret=jax.default_backend() == "cpu")
+        shapes = tuple(zip(st.ms_padded, st.ps_padded))
+        remax = max(st.rows_eval_max, 1)
+        nw = len(plan.widths)
 
-    def local(x, cs_hi, cs_lo, ds, h, t, shift, p, m, hcoef, bcoef, stdnoise):
-        def one(xx, hh, ll):
-            return _cycle_impl(
-                xx, hh, ll, ds, h, t, shift, p, m, hcoef, bcoef, stdnoise,
-                widths, P,
+        def local(xd):
+            x = _pack_static(xd, shapes, kern.rows, kern.P)
+            return kern(x)[..., :remax, :nw]
+
+        fn = jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=(dm,), out_specs=dm
+        ))
+
+        def wrapped(xd, fn=fn):
+            return fn(xd)
+    else:
+        from ..search.engine import _gather_cycle_xd
+
+        b = "bins" if with_bins else None
+        rep = Pspec()
+        in_specs = (
+            dm,
+            Pspec(None, b, None), Pspec(None, b, None), Pspec(None, b, None),
+            Pspec(b), Pspec(b),
+            Pspec(b, None), Pspec(b, None), Pspec(b),
+        )
+        widths, P = plan.widths, plan.P
+
+        def local(xd, h, t, shift, p, m, hcoef, bcoef, stdnoise):
+            return _gather_cycle_xd(
+                xd, h, t, shift, p, m, hcoef, bcoef, stdnoise, widths, P
             )
 
-        return jax.vmap(one)(x, cs_hi, cs_lo)
+        fn = jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=in_specs,
+            out_specs=Pspec("dm", b, None, None),
+        ))
 
-    fn = jax.shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
-    return jax.jit(fn)
+        def wrapped(xd, fn=fn, st=st):
+            ops = _stage_operands(st)
+            return fn(
+                xd, ops["h"], ops["t"], ops["shift"], ops["p"], ops["m"],
+                ops["hcoef"], ops["bcoef"], ops["stdnoise"],
+            )
+    cache[key] = wrapped
+    return wrapped
 
 
-def run_periodogram_sharded(plan, batch, mesh=None):
-    """
-    Execute a periodogram plan over a (D, N) DM-trial batch sharded across
-    a device mesh.
-
-    Parameters
-    ----------
-    plan : PeriodogramPlan
-    batch : (D, N) array of normalised series, N == plan.size
-    mesh : jax.sharding.Mesh with axis 'dm' (and optionally 'bins').
-        Defaults to a 1-D mesh over all devices. D is padded up to a
-        multiple of the dm-axis size; with a 'bins' axis, its size must
-        divide the plan's padded bins-trial count B.
-
-    Returns (periods float64, foldbins uint32, snrs float32 (D, trials, NW)).
-    """
-    from .mesh import default_mesh
-
-    if mesh is None:
-        mesh = default_mesh()
+def _queue_stages_sharded(plan, batch, mesh):
+    """Pad the DM axis to the mesh, then queue every cascade stage as a
+    shard_mapped program. Returns (outs, D_original)."""
     with_bins = "bins" in mesh.axis_names
     dm_size = mesh.shape["dm"]
 
@@ -82,28 +120,70 @@ def run_periodogram_sharded(plan, batch, mesh=None):
     D = batch.shape[0]
     Dpad = -(-D // dm_size) * dm_size
     if Dpad != D:
-        batch = np.concatenate([batch, np.zeros((Dpad - D, plan.size), np.float32)])
-
+        batch = np.concatenate(
+            [batch, np.zeros((Dpad - D, plan.size), np.float32)]
+        )
     if with_bins:
-        B = plan.stages[0].batch.p.shape[0]
+        B = len(plan.stages[0].ps_padded)
         if B % mesh.shape["bins"]:
             raise ValueError(
                 f"bins mesh axis size {mesh.shape['bins']} does not divide "
                 f"the plan's padded bins-trial count {B}"
             )
 
-    x, cs_hi, cs_lo = prepare_batch(plan, batch)
-
-    fn = _sharded_cycle(mesh, plan.widths, plan.P, with_bins)
+    path = _ffa_path()
+    wire = _wire_dtype(path)
+    d64, cs = _prefix64(batch)
     outs = []
     for st in plan.stages:
-        ops = _stage_operands(st)
-        outs.append(
-            fn(
-                x, cs_hi, cs_lo, ops["ds"], ops["h"], ops["t"], ops["shift"],
-                ops["p"], ops["m"], ops["hcoef"], ops["bcoef"], ops["stdnoise"],
-            )
-        )
+        xd = _stage_downsample(st, d64, cs)
+        if path == "kernel" and not with_bins and _kernel_eligible(st, plan):
+            xd = xd[..., : st.n]  # see engine._queue_stages on padding
+        call = _stage_sharded_call(mesh, st, plan, path, with_bins)
+        outs.append(call(jnp.asarray(xd.astype(wire))))
+    return outs, D
+
+
+def run_periodogram_sharded(plan, batch, mesh=None):
+    """
+    Execute a periodogram plan over a (D, N) DM-trial batch sharded
+    across a device mesh; returns the FULL S/N cube
+    (periods float64, foldbins uint32, snrs float32 (D, trials, NW)).
+
+    mesh : jax.sharding.Mesh with axis 'dm' (and optionally 'bins').
+        Defaults to a 1-D mesh over all devices. D is padded up to a
+        multiple of the dm-axis size.
+    """
+    from .mesh import default_mesh
+
+    if mesh is None:
+        mesh = default_mesh()
+    outs, D = _queue_stages_sharded(plan, batch, mesh)
     raw = [np.asarray(o) for o in outs]
     snrs = np.stack([_assemble(plan, [r[d] for r in raw]) for d in range(D)])
     return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs
+
+
+def run_search_sharded(plan, batch, tobs, dms=None, mesh=None, **peak_kwargs):
+    """
+    Distributed survey search with on-device peak detection: the
+    dm-sharded S/N cube never leaves the devices; only KB-sized peak
+    buffers are gathered. Returns (peaks_per_trial, polycos_per_trial)
+    for the ORIGINAL (unpadded) D trials.
+    """
+    from .mesh import default_mesh
+    from ..search.engine import _peak_plan
+    from ..search.peaks_device import device_find_peaks
+
+    if mesh is None:
+        mesh = default_mesh()
+    D = np.asarray(batch).shape[0]
+    if dms is None:
+        dms = np.zeros(D)
+    pp = _peak_plan(plan, tobs, **peak_kwargs)
+    outs, _ = _queue_stages_sharded(plan, batch, mesh)
+    snr_dev = _assemble_device(plan, *outs)
+    Dpad = snr_dev.shape[0]
+    dms_full = np.concatenate([np.asarray(dms, float), np.zeros(Dpad - D)])
+    peaks, polycos = device_find_peaks(pp, snr_dev, dms_full)
+    return peaks[:D], polycos[:D]

@@ -6,14 +6,16 @@ Where the reference forks one OS process per DM trial and searches each
 series with single-threaded C++ on its own CPU core, this stage:
 
 1. loads + de-reddens + normalises a chunk of DM-trial files with a host
-   thread pool (I/O and detrending overlap device compute of the
-   previous chunk — the async-dispatch analog of the reference's
-   fork-based overlap);
+   thread pool, with the NEXT chunk's loads submitted before the current
+   chunk's device search runs (``process_stream``) — so file I/O and
+   detrending genuinely overlap device compute, the async analog of the
+   reference's fork-based overlap;
 2. stacks equal-length series into one HBM-resident (D, N) batch;
 3. runs every configured period range's periodogram plan over the whole
-   batch in a single vmapped program — sharded over the ``dm`` axis of a
-   device mesh when one is supplied (see riptide_tpu.parallel);
-4. runs peak detection per trial on the host (tiny next to the search).
+   batch through the fused Pallas cycle kernel — sharded over the ``dm``
+   axis of a device mesh when one is supplied (riptide_tpu.parallel);
+4. runs peak detection ON DEVICE: only fixed-size peak buffers cross
+   back to the host (riptide_tpu.search.peaks_device).
 
 Only the peaks are kept, mirroring the reference's deliberate choice to
 move file paths in and small Peak lists out of its workers
@@ -26,10 +28,8 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from ..ffautils import generate_width_trials
-from ..peak_detection import find_peaks
-from ..periodogram import Periodogram
 from ..search import periodogram_plan
-from ..search.engine import run_periodogram_batch
+from ..search.engine import run_search_batch
 from ..time_series import TimeSeries
 
 log = logging.getLogger("riptide_tpu.pipeline.batcher")
@@ -82,13 +82,38 @@ class BatchSearcher:
         )
         return ts.normalise()
 
-    # -- one chunk ----------------------------------------------------------
+    # -- chunk processing ---------------------------------------------------
+
+    def process_stream(self, fname_chunks):
+        """Search a stream of DM-trial file chunks with cross-chunk
+        overlap: while the device searches chunk i, the host thread pool
+        is already loading + detrending chunk i+1. Returns a flat list
+        of Peaks."""
+        chunks = [list(c) for c in fname_chunks]
+        peaks = []
+        with ThreadPoolExecutor(max_workers=self.io_threads) as ex:
+            pending = (
+                [ex.submit(self.load_prepared, f) for f in chunks[0]]
+                if chunks else []
+            )
+            for i, chunk in enumerate(chunks):
+                tslist = [f.result() for f in pending]
+                if i + 1 < len(chunks):
+                    pending = [
+                        ex.submit(self.load_prepared, f) for f in chunks[i + 1]
+                    ]
+                peaks.extend(self._process_tslist(tslist))
+                log.debug(
+                    f"Chunk {i + 1}/{len(chunks)} ({len(chunk)} files) done, "
+                    f"total peaks: {len(peaks)}"
+                )
+        return peaks
 
     def process_fname_list(self, fnames):
-        """Search a chunk of DM-trial files; returns a flat list of Peaks."""
-        with ThreadPoolExecutor(max_workers=self.io_threads) as ex:
-            tslist = list(ex.map(self.load_prepared, fnames))
+        """Search one chunk of DM-trial files; returns a flat Peak list."""
+        return self.process_stream([fnames])
 
+    def _process_tslist(self, tslist):
         # Batch programs need equal-shape inputs: group by (nsamp, tsamp).
         # In practice all DM trials of one observation are identical.
         groups = defaultdict(list)
@@ -105,7 +130,6 @@ class BatchSearcher:
                 )
             for conf in self.range_confs:
                 allpeaks.extend(self._search_range(conf, members, batch))
-        log.debug(f"Chunk of {len(fnames)} files done, peaks: {len(allpeaks)}")
         return allpeaks
 
     def _search_range(self, conf, members, batch):
@@ -124,22 +148,19 @@ class BatchSearcher:
             int(kw["bins_min"]),
             int(kw["bins_max"]),
         )
+        dms = [float(ts.metadata["dm"] or 0.0) for ts in members]
+        dms += [0.0] * (batch.shape[0] - len(members))
+        tobs = batch.shape[1] * members[0].tsamp
+        fp_kwargs = conf.get("find_peaks", {})
         if self.mesh is not None:
-            from ..parallel import run_periodogram_sharded
+            from ..parallel import run_search_sharded
 
-            periods, foldbins, snrs = run_periodogram_sharded(
-                plan, batch, mesh=self.mesh
+            peaks_per_trial, _ = run_search_sharded(
+                plan, batch, tobs=tobs, dms=dms, mesh=self.mesh, **fp_kwargs
             )
         else:
-            periods, foldbins, snrs = run_periodogram_batch(plan, batch)
-
-        peaks = []
-        fp_kwargs = conf.get("find_peaks", {})
-        for d, ts in enumerate(members):
-            pgram = Periodogram(
-                np.asarray(widths), periods, foldbins, snrs[d],
-                metadata=ts.metadata,
+            peaks_per_trial, _ = run_search_batch(
+                plan, batch, tobs=tobs, dms=dms, **fp_kwargs
             )
-            found, _polycos = find_peaks(pgram, **fp_kwargs)
-            peaks.extend(found)
-        return peaks
+        # Padded trials (zero data) produce no peaks; slice to real ones.
+        return [p for d in range(len(members)) for p in peaks_per_trial[d]]

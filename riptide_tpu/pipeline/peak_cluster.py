@@ -1,69 +1,95 @@
 """
-Clusters of Peak objects found at the same frequency across DM trials.
+Clusters of Peak objects detected at the same frequency across DM trials.
 
-Same role and dataframe contract as the reference's PeakCluster
-(riptide/pipeline/peak_cluster.py:4-85).
+Produces the same summary-row schema and CSV column order as the
+reference's PeakCluster (riptide/pipeline/peak_cluster.py:4-85) — the
+columns are a file-format contract — with a composition-based container:
+member peaks are stored sorted by decreasing S/N, so the cluster centre
+(best peak) is simply the first member.
 """
+from operator import attrgetter
+
 import pandas
 
 __all__ = ["PeakCluster", "clusters_to_dataframe"]
 
+# CSV schema of clusters.csv — fixed order, integer harmonic columns.
+SUMMARY_COLUMNS = [
+    "rank", "period", "dm", "snr", "ducy", "freq", "npeaks",
+    "hfrac_num", "hfrac_denom", "fundamental_rank",
+]
 
-class PeakCluster(list):
+
+class PeakCluster:
     """
-    A cluster of Peak objects (a list subclass), annotated with its
-    search-wide rank, and — after harmonic flagging — an optional parent
-    fundamental cluster and harmonic fraction.
+    Peaks of one periodicity candidate across DM trials.
+
+    Mutable annotations set by later pipeline stages: ``rank`` (position
+    in the search-wide S/N ordering) and, if harmonic flagging relates
+    this cluster to a stronger one, ``parent_fundamental`` (the
+    fundamental's cluster) and ``hfrac`` (the frequency ratio Fraction).
     """
 
     def __init__(self, peaks, rank=None, parent_fundamental=None, hfrac=None):
-        super().__init__(peaks)
+        self.peaks = sorted(peaks, key=attrgetter("snr"), reverse=True)
+        if not self.peaks:
+            raise ValueError("a PeakCluster needs at least one Peak")
         self.rank = rank
         self.parent_fundamental = parent_fundamental
         self.hfrac = hfrac
+
+    def __iter__(self):
+        return iter(self.peaks)
+
+    def __len__(self):
+        return len(self.peaks)
+
+    def __getitem__(self, i):
+        return self.peaks[i]
+
+    @property
+    def centre(self):
+        """Highest-S/N member (members are kept S/N-sorted)."""
+        return self.peaks[0]
 
     @property
     def is_harmonic(self):
         return self.parent_fundamental is not None
 
-    @property
-    def centre(self):
-        """Member peak with the highest S/N."""
-        return max(self, key=lambda peak: peak.snr)
-
     def summary_dataframe(self):
         """Per-member-peak parameter DataFrame."""
-        return pandas.DataFrame.from_dict([p.summary_dict() for p in self])
+        return pandas.DataFrame.from_dict([p.summary_dict() for p in self.peaks])
 
     def summary_dict(self):
-        """One summary row: centre params + cluster size + harmonic info.
-        Absent harmonic info encodes as 0 / own rank rather than None so
-        the pandas columns stay integer-typed."""
-        return {
-            **self.centre.summary_dict(),
-            "npeaks": len(self),
-            "rank": self.rank,
-            "hfrac_num": self.hfrac.numerator if self.is_harmonic else 0,
-            "hfrac_denom": self.hfrac.denominator if self.is_harmonic else 0,
-            "fundamental_rank": (
-                self.parent_fundamental.rank if self.is_harmonic else self.rank
-            ),
-        }
-
-    def __str__(self):
-        return f"{type(self).__name__}(size={len(self)}, centre={self.centre})"
+        """One clusters.csv row: centre params, member count, rank, and
+        harmonic linkage. Harmonic columns stay integer-typed by encoding
+        "not a harmonic" as hfrac 0/0 with fundamental_rank = own rank."""
+        num = den = 0
+        fundamental = self.rank
+        if self.is_harmonic:
+            num, den = self.hfrac.numerator, self.hfrac.denominator
+            fundamental = self.parent_fundamental.rank
+        return dict(
+            self.centre.summary_dict(),
+            npeaks=len(self.peaks),
+            rank=self.rank,
+            hfrac_num=num,
+            hfrac_denom=den,
+            fundamental_rank=fundamental,
+        )
 
     def __repr__(self):
-        return str(self)
+        return (
+            f"{type(self).__name__}(size={len(self.peaks)}, "
+            f"centre={self.centre})"
+        )
 
 
 def clusters_to_dataframe(clusters):
-    """Summary DataFrame of all clusters, sorted by decreasing S/N, with
-    the reference's fixed column order."""
-    clusters = sorted(clusters, key=lambda c: c.centre.snr, reverse=True)
-    df = pandas.DataFrame.from_dict([cl.summary_dict() for cl in clusters])
-    columns = [
-        "rank", "period", "dm", "snr", "ducy", "freq", "npeaks",
-        "hfrac_num", "hfrac_denom", "fundamental_rank",
+    """Summary DataFrame over clusters, strongest first, in the fixed
+    clusters.csv column order."""
+    rows = [
+        cl.summary_dict()
+        for cl in sorted(clusters, key=lambda c: c.centre.snr, reverse=True)
     ]
-    return df[columns]
+    return pandas.DataFrame.from_dict(rows)[SUMMARY_COLUMNS]

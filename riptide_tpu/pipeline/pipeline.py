@@ -29,7 +29,7 @@ from .. import __version__
 from ..candidate import Candidate
 from ..clustering import cluster1d
 from ..serialization import save_json
-from ..timing import timing
+from ..timing import maybe_trace, timing
 from .batcher import BatchSearcher
 from .config_validation import validate_pipeline_config, validate_ranges
 from .dmiter import DMIterator
@@ -75,9 +75,10 @@ class Pipeline:
         chunk is sharded over its 'dm' axis.
     """
 
-    def __init__(self, config, mesh=None):
+    def __init__(self, config, mesh=None, trace_dir=None):
         self.config = validate_pipeline_config(config)
         self.mesh = mesh
+        self.trace_dir = trace_dir
         self.dmiter = None
         self.searcher = None
         self.peaks = []
@@ -157,9 +158,10 @@ class Pipeline:
         is a host I/O thread count here, not a worker process count)."""
         log.info("Running search")
         batch = max(self.config["processes"], 1)
-        peaks = []
-        for fnames in self.dmiter.iterate_filenames(chunksize=batch):
-            peaks.extend(self.searcher.process_fname_list(fnames))
+        with maybe_trace(self.trace_dir):
+            peaks = self.searcher.process_stream(
+                self.dmiter.iterate_filenames(chunksize=batch)
+            )
         self.peaks = sorted(peaks, key=lambda p: p.period)
         log.info(f"Total peaks found: {len(peaks)}")
 
@@ -381,6 +383,10 @@ def get_parser():
                         help="Logging level for the riptide_tpu logger")
     parser.add_argument("--log-timings", action="store_true",
                         help="Log the execution times of all major functions")
+    parser.add_argument("--trace-dir", type=str, default=None,
+                        help="Capture a jax.profiler device trace of the "
+                             "search stage into this directory (view with "
+                             "TensorBoard's profile plugin or Perfetto)")
     parser.add_argument("--version", action="version", version=__version__)
     parser.add_argument("files", type=str, nargs="+",
                         help="Input file(s) of the configured format")
@@ -408,6 +414,7 @@ def run_program(args):
     )
 
     pipeline = Pipeline.from_yaml_config(args.config)
+    pipeline.trace_dir = getattr(args, "trace_dir", None)
     pipeline.process(args.files, args.outdir)
     log.info("CALCULATIONS CORRECT")
 

@@ -6,7 +6,7 @@ import numpy as np
 from ..ffautils import generate_width_trials
 from ..periodogram import Periodogram
 from ..timing import timing
-from .engine import run_periodogram, run_periodogram_batch
+from .engine import run_periodogram, run_periodogram_batch, run_search_batch
 from .plan import PeriodogramPlan, periodogram_plan
 
 __all__ = [
@@ -15,6 +15,7 @@ __all__ = [
     "PeriodogramPlan",
     "run_periodogram",
     "run_periodogram_batch",
+    "run_search_batch",
 ]
 
 

@@ -35,7 +35,8 @@ from ..ops.ffa import ffa_levels
 from ..ops.ffa_kernel import NWPAD
 from ..ops.snr import snr_batched
 
-__all__ = ["run_periodogram", "run_periodogram_batch", "cycle_fn"]
+__all__ = ["run_periodogram", "run_periodogram_batch", "run_search_batch",
+           "cycle_fn"]
 
 
 def _pack(xd, p, m, R, P):
@@ -118,29 +119,49 @@ def _prefix64(data):
     return data, cs
 
 
-def host_downsample(plan, data):
-    """All cascade downsamplings of one series, on the host in float64.
-    Returns (num_stages, plan.nout) float32."""
-    d64, cs = _prefix64(data)
-    out = np.zeros((len(plan.stages), plan.nout), np.float32)
-    for i, st in enumerate(plan.stages):
-        out[i] = _stage_downsample(st, d64, cs)
-    return out
+def _peak_plan(plan, tobs, **peak_kwargs):
+    """Per-plan cached PeakPlan (shared by the unsharded and sharded
+    survey paths so identical inputs reuse one plan)."""
+    from .peaks_device import PeakPlan
+
+    key = (float(tobs), tuple(sorted(peak_kwargs.items())))
+    cache = getattr(plan, "_peak_plans", None)
+    if cache is None:
+        cache = plan._peak_plans = {}
+    pp = cache.get(key)
+    if pp is None:
+        pp = cache[key] = PeakPlan(plan, tobs, **peak_kwargs)
+    return pp
 
 
 @partial(jax.jit, static_argnames=("shapes", "rows", "P"))
 def _pack_static(xd, shapes, rows, P):
     """
     Static pack: per-problem reshape + zero-pad of a downsampled series
-    into the (..., B, rows, P) kernel container. Pure data movement (no
-    gather): problem b is xd[..., : m*p] viewed as (m, p) then padded.
+    into the (..., B, rows, P) float32 kernel container. Pure data
+    movement (no gather): problem b is xd[..., : m*p] viewed as (m, p)
+    then padded. Accepts a float16 wire-format input (see _wire_dtype).
     """
+    xd = xd.astype(jnp.float32)
     outs = []
     for m, p in shapes:
         seg = xd[..., : m * p].reshape(xd.shape[:-1] + (m, p))
         pad = [(0, 0)] * (seg.ndim - 2) + [(0, rows - m), (0, P - p)]
         outs.append(jnp.pad(seg, pad))
     return jnp.stack(outs, axis=-3)
+
+
+def _wire_dtype(path):
+    """Host->device wire dtype for downsampled stage data. float16 by
+    default on the kernel path: the values are normalised (unit-variance
+    noise x sqrt(factor)), so the 11-bit mantissa costs ~5e-4 relative
+    per sample — an S/N error ~EPS*S/N ~ 0.01 at the parity bar of
+    18.5 +/- 0.15 — while halving the dominant transfer. Override with
+    RIPTIDE_WIRE_DTYPE=float32|float16."""
+    mode = os.environ.get("RIPTIDE_WIRE_DTYPE")
+    if mode:
+        return np.dtype(mode)
+    return np.dtype(np.float16 if path == "kernel" else np.float32)
 
 
 @partial(jax.jit, static_argnames=("widths", "P"))
@@ -238,6 +259,66 @@ def _assemble(plan, raw_per_stage):
     return np.empty((0, nw), np.float32)
 
 
+@partial(jax.jit, static_argnames=("plan",))
+def _assemble_device(plan, *outs):
+    """Device-side counterpart of :func:`_assemble`: slice every stage's
+    evaluated rows and concatenate in plan trial order, keeping the
+    (D, n_trials, NW) S/N cube on the device (for on-device peak
+    detection — only KB-sized peak summaries then cross to the host)."""
+    chunks = []
+    for st, raw in zip(plan.stages, outs):
+        for i, re in enumerate(st.rows_eval):
+            if re:
+                chunks.append(raw[:, i, :re, :])
+    return jnp.concatenate(chunks, axis=1)
+
+
+def _queue_stages(plan, batch):
+    """Shared stage loop: host downsampling overlapped with async device
+    queueing. Ships each stage's UNPADDED samples (the cascade's padded
+    plan length nout is up to ~2x the real output size) in the wire
+    dtype. Returns the list of per-stage device outputs."""
+    batch = np.asarray(batch, dtype=np.float32)
+    if batch.ndim != 2 or batch.shape[1] != plan.size:
+        raise ValueError("batch must be (D, N) with N matching the plan")
+    path = _ffa_path()
+    wire = _wire_dtype(path)
+    d64, cs = _prefix64(batch)
+    outs = []
+    for st in plan.stages:
+        xd = _stage_downsample(st, d64, cs)
+        if path == "kernel" and _kernel_eligible(st, plan):
+            # Kernel-path programs are keyed by bucket shape, not series
+            # length: ship only the unpadded samples. Gather-path
+            # programs ARE keyed by length — keep the plan-wide padding
+            # so all stages share one compiled program.
+            xd = xd[..., : st.n]
+        outs.append(_run_stage(st, jnp.asarray(xd.astype(wire)), plan, path))
+    return outs
+
+
+def run_search_batch(plan, batch, tobs, dms=None, **peak_kwargs):
+    """
+    Full batched search with ON-DEVICE peak detection: periodogram
+    stages -> device-side assembly -> device thresholding/selection ->
+    host clustering. The (D, trials, widths) S/N cube never crosses to
+    the host; per DM trial only fixed-size peak buffers do (SURVEY §5
+    distributed-comms posture; reference semantics
+    riptide/peak_detection.py:146-222).
+
+    Returns (peaks_per_trial, polycos_per_trial).
+    """
+    from .peaks_device import device_find_peaks
+
+    D = np.asarray(batch).shape[0]
+    if dms is None:
+        dms = np.zeros(D)
+    pp = _peak_plan(plan, tobs, **peak_kwargs)
+    outs = _queue_stages(plan, batch)
+    snr_dev = _assemble_device(plan, *outs)
+    return device_find_peaks(pp, snr_dev, dms)
+
+
 def run_periodogram(plan, data):
     """
     Execute a :class:`~riptide_tpu.search.plan.PeriodogramPlan` on a single
@@ -251,11 +332,14 @@ def run_periodogram(plan, data):
     if data.size != plan.size:
         raise ValueError("data length does not match plan size")
     path = _ffa_path()
-    xds = host_downsample(plan, data)
-    outs = [
-        _run_stage(st, jnp.asarray(xds[i]), plan, path)
-        for i, st in enumerate(plan.stages)
-    ]
+    wire = _wire_dtype(path)
+    d64, cs = _prefix64(data)
+    outs = []
+    for st in plan.stages:
+        xd = _stage_downsample(st, d64, cs)
+        if path == "kernel" and _kernel_eligible(st, plan):
+            xd = xd[: st.n]  # see _queue_stages on padding vs compiles
+        outs.append(_run_stage(st, jnp.asarray(xd.astype(wire)), plan, path))
     # One host sync at the end: device work for all cycles is queued
     # asynchronously, then gathered.
     raw = [np.asarray(o) for o in outs]
@@ -283,20 +367,12 @@ def run_periodogram_batch(plan, batch):
 
     Returns (periods, foldbins, snrs (D, len, NW)).
     """
-    batch = np.asarray(batch, dtype=np.float32)
-    if batch.ndim != 2 or batch.shape[1] != plan.size:
-        raise ValueError("batch must be (D, N) with N matching the plan")
-    D = batch.shape[0]
-    path = _ffa_path()
     # Stage-wise: downsample stage i for the whole batch on the host,
     # ship it, queue the device stage, then move to stage i+1 — so host
     # prep of later stages genuinely overlaps device execution of
     # earlier ones (device calls are asynchronous).
-    d64, cs = _prefix64(batch)
-    outs = []
-    for st in plan.stages:
-        xd = jnp.asarray(_stage_downsample(st, d64, cs))
-        outs.append(_run_stage(st, xd, plan, path))
+    outs = _queue_stages(plan, batch)
+    D = np.asarray(batch).shape[0]
     raw = [np.asarray(o) for o in outs]  # (D, B, rows<=R, NW) each
     snrs = np.stack(
         [_assemble(plan, [r[d] for r in raw]) for d in range(D)]

@@ -1,0 +1,227 @@
+"""
+On-device peak detection for batched periodogram searches.
+
+Replicates the reference's find_peaks semantics
+(riptide/peak_detection.py:37-142) while keeping the (D, trials, widths)
+S/N cube on the device; only kilobyte-sized summaries cross to the host:
+
+1. device: per-(trial, width) segment percentiles of the S/N column
+   (the reshape + median/IQR of ``segment_stats``) -> (D, NW, nseg, 3)
+   float32, a ~100 KB pull;
+2. host: exact float64 ``np.polyfit`` of the threshold control points
+   (identical math to the reference, which uses float64 numpy);
+3. device: dynamic threshold evaluated from the fitted coefficients,
+   mask ``s > max(dynthr, smin)`` widened by a small epsilon, first-K
+   selected (trial index, S/N) pairs per (D, width) -> the only other
+   pull, K * 8 bytes per column;
+4. host: exact threshold re-check in float64 on the pulled points (the
+   epsilon margin absorbs device float32 rounding), then the reference's
+   friends-of-friends clustering + per-cluster argmax -> Peak tuples.
+
+The devil in (3): candidate counts are data-dependent, so the device
+emits a fixed-size buffer of the K selected points with the SMALLEST
+trial indices (order statistics over masked indices via top_k), plus the
+true selected count for overflow detection. K defaults high enough that
+real searches never overflow; on overflow the affected column falls back
+to pulling its full S/N column.
+"""
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+from ..clustering import cluster1d
+from ..peak_detection import Peak, fit_threshold
+
+log = logging.getLogger("riptide_tpu.peaks_device")
+
+__all__ = ["PeakPlan", "device_find_peaks"]
+
+# Margin (in S/N units) by which the device-side threshold is lowered;
+# marginal points are re-judged on host in float64. Device f32 rounding
+# of the threshold polynomial is ~1e-5 relative; 1e-2 absolute is safe.
+EPS = 1e-2
+
+
+class PeakPlan:
+    """Static (data-independent) part of on-device peak detection for one
+    periodogram plan + observation length."""
+
+    def __init__(self, plan, tobs, smin=6.0, segwidth=5.0, nstd=6.0,
+                 minseg=10, polydeg=2, clrad=0.1, K=4096):
+        freqs = 1.0 / plan.all_periods  # decreasing, like Periodogram.freqs
+        n = freqs.size
+        w = segwidth / tobs
+        nseg = int(np.ceil(abs(freqs[-1] - freqs[0]) / w))
+        pts = n // nseg
+        self.plan = plan
+        self.tobs = float(tobs)
+        self.smin = float(smin)
+        self.nstd = float(nstd)
+        self.minseg = int(minseg)
+        self.polydeg = int(polydeg)
+        self.clrad = float(clrad)
+        self.K = int(min(K, n))
+        self.n = n
+        self.nseg = nseg
+        self.pts = pts
+        self.freqs = freqs
+        # Static control-point frequencies (median f per segment) and the
+        # log-f evaluation grid (device side, float32).
+        self.fc = np.median(freqs[: nseg * pts].reshape(nseg, pts), axis=1)
+        self.logf = np.log(freqs).astype(np.float32)
+
+    # -- step 1: device segment stats ------------------------------------
+
+    @partial(jax.jit, static_argnames=("self",))
+    def _stats(self, snr):
+        """snr: (D, n, NW) f32 -> (D, NW, nseg, 3) [p25, p50, p75]."""
+        seg = snr[:, : self.nseg * self.pts, :]
+        D, _, NW = seg.shape
+        seg = seg.transpose(0, 2, 1).reshape(D, NW, self.nseg, self.pts)
+        q = jnp.percentile(seg, jnp.asarray([25.0, 50.0, 75.0]), axis=-1)
+        return q.transpose(1, 2, 3, 0)  # (D, NW, nseg, 3)
+
+    # -- step 2: host polyfit --------------------------------------------
+
+    def _fit(self, stats):
+        """stats: (D, NW, nseg, 3) -> (D, NW, polydeg+1) float64 polyco.
+        Mirrors find_peaks_single: threshold control points are
+        smed + nstd * (IQR / 1.349); static-smin fallback when the
+        segment count is below minseg (riptide/peak_detection.py:126)."""
+        D, NW = stats.shape[:2]
+        polyco = np.zeros((D, NW, self.polydeg + 1))
+        s25 = stats[..., 0].astype(np.float64)
+        smed = stats[..., 1].astype(np.float64)
+        s75 = stats[..., 2].astype(np.float64)
+        tc = smed + self.nstd * (s75 - s25) / 1.349
+        if self.nseg < self.minseg:
+            polyco[..., -1] = self.smin
+            return polyco
+        for d in range(D):
+            for iw in range(NW):
+                polyco[d, iw, :] = fit_threshold(
+                    self.fc, tc[d, iw], polydeg=self.polydeg
+                ).coefficients
+        return polyco
+
+    # -- step 3: device mask + first-K selection -------------------------
+
+    @partial(jax.jit, static_argnames=("self",))
+    def _select(self, snr, polyco):
+        """snr (D, n, NW), polyco (D, NW, deg+1) f32 ->
+        idx (D, NW, K) int32, val (D, NW, K) f32, count (D, NW) int32.
+
+        First-K compaction by cumsum + scatter-add: each selected point's
+        output slot is its rank among selected points (selected points
+        land on distinct slots; unselected add zero). top_k/sort over the
+        full n=2e5 axis is avoided deliberately — XLA's large-k sorting
+        networks take minutes to compile at this width."""
+        logf = jnp.asarray(self.logf)
+        # Horner evaluation of the threshold polynomial at every trial.
+        thr = jnp.zeros(polyco.shape[:2] + (self.n,), jnp.float32)
+        for k in range(polyco.shape[-1]):
+            thr = thr * logf[None, None, :] + polyco[:, :, k, None]
+        s = snr.transpose(0, 2, 1)  # (D, NW, n)
+        mask = (s > thr - EPS) & (s > self.smin - EPS)
+        count = mask.sum(axis=-1).astype(jnp.int32)
+        D, NW, n = s.shape
+        pos = jnp.cumsum(mask, axis=-1) - 1           # rank of each point
+        ok = mask & (pos < self.K)
+        posc = jnp.clip(pos, 0, self.K - 1)
+        dd = jnp.arange(D)[:, None, None]
+        ww = jnp.arange(NW)[None, :, None]
+        iota = jnp.arange(n, dtype=jnp.int32)[None, None, :]
+        zeros = jnp.zeros((D, NW, self.K), jnp.float32)
+        idx = zeros.astype(jnp.int32).at[dd, ww, posc].add(
+            jnp.where(ok, iota, 0)
+        )
+        val = zeros.at[dd, ww, posc].add(jnp.where(ok, s, 0.0))
+        slot = jnp.arange(self.K)[None, None, :]
+        valid = slot < jnp.minimum(count, self.K)[..., None]
+        return idx, jnp.where(valid, val, -jnp.inf), count
+
+    # -- step 4: host exact threshold + clustering -----------------------
+
+    def _finalize(self, idx, val, count, polyco, widths, foldbins, dms,
+                  snr_dev=None):
+        D, NW = count.shape
+        peaks_per_trial = [[] for _ in range(D)]
+        polycos = [{} for _ in range(D)]
+        logf64 = np.log(self.freqs)
+        for d in range(D):
+            for iw in range(NW):
+                pc = polyco[d, iw]
+                poly = np.poly1d(pc if self.nseg >= self.minseg else [self.smin])
+                polycos[d][iw] = poly.coefficients
+                k = min(int(count[d, iw]), self.K)
+                if k == 0:
+                    continue
+                if count[d, iw] > self.K and snr_dev is not None:
+                    # Buffer overflow (heavy RFI): fall back to pulling
+                    # this one column's full S/N and selecting on host.
+                    log.warning(
+                        "peak buffer overflow (%d > K=%d) for trial %d "
+                        "width %d; pulling the full S/N column",
+                        count[d, iw], self.K, d, widths[iw],
+                    )
+                    sfull = np.asarray(snr_dev[d, :, iw], dtype=np.float64)
+                    keep_full = (sfull > poly(logf64)) & (sfull > self.smin)
+                    ix = np.where(keep_full)[0]
+                    sv = sfull[ix]
+                else:
+                    ix = np.asarray(idx[d, iw, :k], dtype=np.int64)
+                    sv = np.asarray(val[d, iw, :k], dtype=np.float64)
+                # exact float64 re-check (the device applied thr - EPS)
+                keep = (sv > poly(logf64[ix])) & (sv > self.smin)
+                ix, sv = ix[keep], sv[keep]
+                if ix.size == 0:
+                    continue
+                fsel = self.freqs[ix]
+                for cl in cluster1d(fsel, self.clrad / self.tobs):
+                    j = cl[sv[cl].argmax()]
+                    ip = int(ix[j])
+                    fpk = float(self.freqs[ip])
+                    peaks_per_trial[d].append(Peak(
+                        period=float(1.0 / fpk), freq=fpk,
+                        width=int(widths[iw]),
+                        ducy=float(widths[iw]) / float(foldbins[ip]),
+                        iw=int(iw), ip=ip, snr=float(sv[j]),
+                        dm=float(dms[d]),
+                    ))
+        return (
+            [sorted(pk, key=lambda p: p.snr, reverse=True)
+             for pk in peaks_per_trial],
+            polycos,
+        )
+
+
+def device_find_peaks(peak_plan, snr_dev, dms):
+    """
+    Run the 4-step on-device peak detection.
+
+    Parameters
+    ----------
+    peak_plan : PeakPlan
+    snr_dev : (D, n_trials, NW) device array (or anything jnp.asarray
+        accepts) of S/N values in plan trial order
+    dms : (D,) DM value per batch row
+
+    Returns (peaks_per_trial, polycos_per_trial) where peaks_per_trial[d]
+    is a list of Peak sorted by decreasing S/N — the contract of the
+    host ``find_peaks`` (riptide/peak_detection.py:146-222).
+    """
+    plan = peak_plan.plan
+    snr_dev = jnp.asarray(snr_dev)
+    stats = np.asarray(peak_plan._stats(snr_dev))          # pull ~100 KB
+    polyco = peak_plan._fit(stats)
+    idx, val, count = peak_plan._select(
+        snr_dev, jnp.asarray(polyco, dtype=jnp.float32)
+    )
+    idx, val, count = np.asarray(idx), np.asarray(val), np.asarray(count)
+    return peak_plan._finalize(
+        idx, val, count, polyco, plan.widths, plan.all_foldbins, dms,
+        snr_dev=snr_dev,
+    )

@@ -1,12 +1,34 @@
 """Wall-clock timing decorator logging to the 'riptide_tpu.timing' logger
-at DEBUG level (reference: riptide/timing.py)."""
+at DEBUG level (reference: riptide/timing.py), plus the device-side
+profiler hook the reference has no analog for: ``device_trace`` captures
+a jax.profiler trace (kernel-level timeline, HBM/VMEM stats, XLA op
+breakdown) viewable in TensorBoard or Perfetto."""
 import logging
 import time
+from contextlib import contextmanager, nullcontext
 from functools import wraps
 
 log = logging.getLogger("riptide_tpu.timing")
 
-__all__ = ["timing"]
+__all__ = ["timing", "device_trace", "maybe_trace"]
+
+
+@contextmanager
+def device_trace(trace_dir):
+    """Capture a jax.profiler device trace of the enclosed block into
+    ``trace_dir`` (open with TensorBoard's profile plugin or Perfetto)."""
+    import jax
+
+    log.info(f"capturing device trace to {trace_dir}")
+    with jax.profiler.trace(str(trace_dir)):
+        yield
+    log.info(f"device trace written to {trace_dir}")
+
+
+def maybe_trace(trace_dir):
+    """``device_trace(trace_dir)`` when a directory is given, else a
+    no-op context."""
+    return device_trace(trace_dir) if trace_dir else nullcontext()
 
 
 def timing(func):

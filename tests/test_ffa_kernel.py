@@ -141,6 +141,7 @@ def test_engine_kernel_path_parity(monkeypatch):
     rng = np.random.default_rng(3)
     data = rng.standard_normal(4096).astype(np.float32)
 
+    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "float32")
     monkeypatch.setenv("RIPTIDE_FFA_PATH", "gather")
     pg, fg, sg = run_periodogram(plan, data)
     monkeypatch.setenv("RIPTIDE_FFA_PATH", "kernel")
@@ -155,3 +156,11 @@ def test_engine_kernel_path_parity(monkeypatch):
     monkeypatch.setenv("RIPTIDE_FFA_PATH", "gather")
     _, _, sbg = run_periodogram_batch(plan, batch)
     np.testing.assert_allclose(sbk, sbg, rtol=2e-4, atol=2e-4)
+
+    # The float16 wire format (the kernel path's default) trades ~1e-3
+    # absolute S/N error for half the host->device traffic — well inside
+    # the reference parity bar of +/-0.15.
+    monkeypatch.setenv("RIPTIDE_FFA_PATH", "kernel")
+    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "float16")
+    _, _, s16 = run_periodogram(plan, data)
+    np.testing.assert_allclose(s16, sg, atol=2e-2)

@@ -1,0 +1,86 @@
+"""
+On-device peak detection vs the host reference implementation.
+
+The contract (VERDICT round-2 ask #3): identical candidates on the
+synthetic-pulsar test via the on-device path, with only KB-sized peak
+buffers crossing the device boundary.
+"""
+import numpy as np
+import pytest
+
+from riptide_tpu.libffa import generate_signal
+from riptide_tpu.metadata import Metadata
+from riptide_tpu.peak_detection import find_peaks
+from riptide_tpu.periodogram import Periodogram
+from riptide_tpu.search.engine import run_periodogram_batch, run_search_batch
+from riptide_tpu.search.plan import periodogram_plan
+
+
+TSAMP = 1e-3
+N = 65536  # 65.5 s
+PKW = dict(smin=6.0, segwidth=5.0, nstd=6.0, minseg=10, polydeg=2, clrad=0.1)
+
+
+@pytest.fixture(scope="module")
+def search_setup():
+    plan = periodogram_plan(N, TSAMP, (1, 2, 3, 4, 6), 0.3, 1.5, 64, 71)
+    rng = np.random.RandomState(42)
+    batch = np.empty((3, N), np.float32)
+    # trial 0: bright pulsar, trial 1: pure noise, trial 2: faint pulsar
+    np.random.seed(1)
+    batch[0] = generate_signal(N, 0.5 / TSAMP, amplitude=18.0, ducy=0.03)
+    batch[1] = rng.normal(size=N).astype(np.float32)
+    np.random.seed(2)
+    batch[2] = generate_signal(N, 0.9 / TSAMP, amplitude=10.0, ducy=0.05)
+    # normalise (the engine expects normalised input)
+    batch -= batch.mean(axis=1, keepdims=True)
+    batch /= batch.std(axis=1, keepdims=True)
+    return plan, batch
+
+
+def _host_peaks(plan, batch, dms):
+    periods, foldbins, snrs = run_periodogram_batch(plan, batch)
+    out = []
+    for d in range(batch.shape[0]):
+        md = Metadata({"dm": float(dms[d]), "tobs": N * TSAMP})
+        pgram = Periodogram(plan.widths, periods, foldbins, snrs[d], md)
+        peaks, polycos = find_peaks(pgram, **PKW)
+        out.append(peaks)
+    return out
+
+
+def test_device_peaks_match_host(search_setup):
+    plan, batch = search_setup
+    dms = [0.0, 10.0, 20.0]
+    host = _host_peaks(plan, batch, dms)
+    dev, _ = run_search_batch(plan, batch, tobs=N * TSAMP, dms=dms, **PKW)
+
+    assert len(dev) == len(host)
+    for d, (hp, dp) in enumerate(zip(host, dev)):
+        hset = [(p.ip, p.iw, round(p.snr, 4)) for p in hp]
+        dset = [(p.ip, p.iw, round(p.snr, 4)) for p in dp]
+        assert dset == hset, f"trial {d}: {dset} != {hset}"
+        for p in dp:
+            assert p.dm == dms[d]
+
+
+def test_device_peaks_recover_pulsar(search_setup):
+    plan, batch = search_setup
+    dev, polycos = run_search_batch(plan, batch, tobs=N * TSAMP, **PKW)
+    # bright pulsar found at P = 0.5 s
+    assert dev[0], "no peaks found for the bright pulsar"
+    top = dev[0][0]
+    assert abs(top.period - 0.5) < 1e-3
+    assert top.snr > 15
+    # peaks sorted by decreasing S/N; polycos present per width
+    snrs = [p.snr for p in dev[0]]
+    assert snrs == sorted(snrs, reverse=True)
+    assert set(polycos[0].keys()) <= set(range(len(plan.widths)))
+
+
+def test_device_peaks_noise_only(search_setup):
+    plan, batch = search_setup
+    dev, _ = run_search_batch(plan, batch, tobs=N * TSAMP, **PKW)
+    # pure-noise trial: no (or only marginal) detections above smin
+    for p in dev[1]:
+        assert p.snr < 8.0

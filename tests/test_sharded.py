@@ -1,0 +1,127 @@
+"""
+Mesh-sharded search paths on the virtual 8-device CPU mesh
+(tests/conftest.py forces JAX_PLATFORMS=cpu with
+--xla_force_host_platform_device_count=8).
+
+Covers the round-2 gaps: pytest coverage of run_periodogram_sharded
+(1-D and 2-D meshes, D not divisible by the dm axis), the tiny-gather
+survey path run_search_sharded, and a Pipeline(mesh=...) end-to-end run
+(posture: riptide/tests/test_pipeline.py:14-31).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from riptide_tpu.parallel import run_periodogram_sharded
+from riptide_tpu.parallel.mesh import default_mesh, mesh_2d
+from riptide_tpu.parallel.sharded import run_search_sharded
+from riptide_tpu.search.engine import run_periodogram_batch, run_search_batch
+from riptide_tpu.search.plan import periodogram_plan
+from riptide_tpu.libffa import generate_signal
+
+TSAMP = 1e-3
+N = 32768
+PKW = dict(smin=6.0, segwidth=5.0, nstd=6.0, minseg=10, polydeg=2, clrad=0.1)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    plan = periodogram_plan(N, TSAMP, (1, 2, 3, 4), 64 * TSAMP, 0.3, 64, 71)
+    rng = np.random.RandomState(7)
+    batch = rng.normal(size=(5, N)).astype(np.float32)  # 5 % 8 != 0, 5 % 4 != 0
+    np.random.seed(5)
+    batch[2] = generate_signal(N, 0.1 / TSAMP, amplitude=16.0, ducy=0.05)
+    batch -= batch.mean(axis=1, keepdims=True)
+    batch /= batch.std(axis=1, keepdims=True)
+    _, _, ref = run_periodogram_batch(plan, batch)
+    return plan, batch, ref
+
+
+def test_sharded_1d_mesh_parity(setup):
+    plan, batch, ref = setup
+    mesh = default_mesh()  # 8 devices on the 'dm' axis; D=5 gets padded
+    assert mesh.shape["dm"] == len(jax.devices())
+    periods, foldbins, snrs = run_periodogram_sharded(plan, batch, mesh=mesh)
+    assert snrs.shape == ref.shape
+    np.testing.assert_allclose(snrs, ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(periods, plan.all_periods)
+
+
+def test_sharded_2d_mesh_parity(setup):
+    plan, batch, ref = setup
+    # B = 71 - 64 + 1 = 8 bins-trials, divisible by bins_shards=2
+    mesh = mesh_2d(jax.devices(), bins_shards=2)
+    _, _, snrs = run_periodogram_sharded(plan, batch, mesh=mesh)
+    np.testing.assert_allclose(snrs, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_2d_mesh_bad_bins_axis(setup):
+    plan, batch, _ = setup
+    mesh = mesh_2d(jax.devices()[:6], bins_shards=3)  # 3 does not divide 8
+    with pytest.raises(ValueError, match="does not divide"):
+        run_periodogram_sharded(plan, batch, mesh=mesh)
+
+
+def test_sharded_small_dm_axis(setup):
+    """dm axis smaller than the device count, D divisible."""
+    plan, batch, ref = setup
+    mesh = default_mesh(jax.devices()[:5])
+    _, _, snrs = run_periodogram_sharded(plan, batch, mesh=mesh)
+    np.testing.assert_allclose(snrs, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_search_sharded_tiny_gather(setup):
+    """The survey path: dm-sharded on-device peaks == unsharded peaks,
+    and only peak buffers (not the S/N cube) reach the host."""
+    plan, batch, _ = setup
+    tobs = N * TSAMP
+    dms = [0.0, 5.0, 10.0, 15.0, 20.0]
+    want, _ = run_search_batch(plan, batch, tobs=tobs, dms=dms, **PKW)
+    got, _ = run_search_sharded(
+        plan, batch, tobs=tobs, dms=dms, mesh=default_mesh(), **PKW
+    )
+    assert len(got) == len(batch)
+    for d in range(len(batch)):
+        wset = [(p.ip, p.iw, round(p.snr, 4), p.dm) for p in want[d]]
+        gset = [(p.ip, p.iw, round(p.snr, 4), p.dm) for p in got[d]]
+        assert gset == wset, f"trial {d}"
+    # the injected pulsar must be recovered through the sharded path
+    assert got[2] and abs(got[2][0].period - 0.1) < 1e-3
+
+
+def test_pipeline_with_mesh(tmp_path):
+    """Pipeline(mesh=...) end-to-end on synthetic PRESTO data: the
+    DM-10 fake pulsar must come out as the top candidate through the
+    mesh-sharded search (posture of the reference's real-multiprocess
+    pipeline test, riptide/tests/test_pipeline.py:39-74)."""
+    import os
+    import sys
+    import yaml
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__)))
+    from synth import generate_data_presto
+
+    from riptide_tpu.pipeline.pipeline import Pipeline
+
+    indir = tmp_path / "data"
+    outdir = tmp_path / "out"
+    indir.mkdir()
+    outdir.mkdir()
+    fnames = []
+    for dm, amp in ((0.0, 10.0), (10.0, 20.0), (20.0, 10.0)):
+        fnames.append(generate_data_presto(
+            str(indir), f"fake_DM{dm:.2f}", tobs=128.0, tsamp=256e-6,
+            period=1.0, dm=dm, amplitude=amp, ducy=0.02,
+        ))
+    conf_path = os.path.join(os.path.dirname(__file__), "pipeline_config_A.yml")
+    with open(conf_path) as f:
+        conf = yaml.safe_load(f)
+
+    pipe = Pipeline(conf, mesh=default_mesh())
+    pipe.process(fnames, str(outdir))
+    assert pipe.candidates, "no candidates from the mesh-sharded pipeline"
+    best = pipe.candidates[0]
+    assert abs(best.params["period"] - 1.0) < 1e-3
+    assert best.params["dm"] == 10.0
+    assert 17.0 < best.params["snr"] < 20.0
