@@ -26,6 +26,7 @@ __all__ = [
     "benchmark_ffa",
     "running_median",
     "downsample",
+    "downsample_stages",
     "circular_prefix_sum",
     "boxcar_snr",
 ]
@@ -36,7 +37,7 @@ _BUILD_DIR = os.path.join(_HERE, "_build")
 # Compile flags are part of the cache key: a .so built with different
 # flags (e.g. an old -march=native artifact on a shared filesystem) must
 # not pass the staleness check on a host it could crash.
-_FLAGS = ("-O3", "-std=c++17", "-shared", "-fPIC")
+_FLAGS = ("-O3", "-std=c++17", "-shared", "-fPIC", "-pthread")
 
 
 def _flags_tag():
@@ -118,6 +119,14 @@ def _bind(lib):
     lib.rn_boxcar_snr.argtypes = [
         _f32("C_CONTIGUOUS"), c64, c64, i64p, c64, ctypes.c_float,
         _f32("C_CONTIGUOUS"),
+    ]
+    lib.rn_downsample_stages.restype = None
+    lib.rn_downsample_stages.argtypes = [
+        _f32("C_CONTIGUOUS"), c64, c64,           # batch, D, N
+        i32p, i32p,                               # imin, imax (S, nout)
+        _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"),
+        c64, c64, c64, ctypes.c_int,              # S, nout, nthreads, as_f16
+        ctypes.c_void_p,                          # out (S, D, nout)
     ]
     return lib
 
@@ -237,6 +246,40 @@ def circular_prefix_sum(data, nsum):
     data = np.ascontiguousarray(data, np.float32)
     out = np.empty(int(nsum), np.float64)
     lib.rn_circular_prefix_sum(data, data.size, int(nsum), out)
+    return out
+
+
+def downsample_stages(batch, imin, imax, wmin, wmax, wint, dtype=np.float32,
+                      nthreads=None):
+    """
+    All cascade stages' real-factor downsamplings of a (D, N) float32
+    batch, threaded over (stage, trial) pairs with per-trial float64
+    prefix sums (the host half of the search engine's cascade).
+
+    imin/imax : (S, nout) int32; wmin/wmax/wint : (S, nout) float32.
+    Returns (S, D, nout) in ``dtype`` (float32 or float16 — the float16
+    conversion is done natively, round-to-nearest-even).
+    """
+    lib = _require()
+    batch = np.ascontiguousarray(batch, np.float32)
+    D, N = batch.shape
+    S, nout = imin.shape
+    dtype = np.dtype(dtype)
+    if dtype not in (np.dtype(np.float32), np.dtype(np.float16)):
+        raise ValueError("dtype must be float32 or float16")
+    if nthreads is None:
+        nthreads = min(max(os.cpu_count() or 1, 1), 32)
+    out = np.empty((S, D, nout), dtype)
+    lib.rn_downsample_stages(
+        batch, D, N,
+        np.ascontiguousarray(imin, np.int32),
+        np.ascontiguousarray(imax, np.int32),
+        np.ascontiguousarray(wmin, np.float32),
+        np.ascontiguousarray(wmax, np.float32),
+        np.ascontiguousarray(wint, np.float32),
+        S, nout, int(nthreads), int(dtype == np.dtype(np.float16)),
+        out.ctypes.data,
+    )
     return out
 
 

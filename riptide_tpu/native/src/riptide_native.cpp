@@ -24,6 +24,11 @@
 #include <cstring>
 #include <set>
 #include <vector>
+#include <thread>
+#include <atomic>
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
 
 extern "C" {
 
@@ -251,6 +256,146 @@ void rn_boxcar_snr(const float* x, int64_t rows, int64_t bins,
             out[r * nw + i] = static_cast<float>(((h + b) * dmax - b * total) / stdnoise);
         }
     }
+}
+
+
+// ---------------------------------------------------------------------------
+// Threaded all-stages batch downsampling (the host side of the search
+// engine's cascade; see riptide_tpu/search/engine.py).
+//
+// For each trial d: one float64 inclusive prefix sum of x[d] (leading 0),
+// then for every stage s and output sample k:
+//   out[s,d,k] = wmin[s,k] * x[d, imin[s,k]]
+//              + wint[s,k] * (cs[imax[s,k]] - cs[imin[s,k] + 1])
+//              + wmax[s,k] * x[d, imax[s,k]]
+// matching engine._stage_downsample / the reference's double accumulator
+// (riptide/cpp/downsample.hpp:44-82). Output is float32 or IEEE float16
+// (round-to-nearest-even, software conversion for ISA portability).
+// Work is spread over threads by (stage, trial) pairs; prefix sums are
+// computed per trial by the first pair that needs them.
+
+static uint16_t f32_to_f16_rne(float value) {
+    uint32_t x;
+    std::memcpy(&x, &value, 4);
+    const uint32_t sign = (x >> 16) & 0x8000u;
+    x &= 0x7fffffffu;
+    if (x >= 0x47800000u) {                 // overflow -> inf; keep nan
+        const uint16_t mant = (x > 0x7f800000u) ? 0x200u : 0u;
+        return static_cast<uint16_t>(sign | 0x7c00u | mant);
+    }
+    if (x < 0x38800000u) {                  // f16 subnormal or zero
+        if (x < 0x33000000u) return static_cast<uint16_t>(sign);
+        const int shift = 126 - static_cast<int>(x >> 23);  // in [14, 24]
+        const uint32_t mant = (x & 0x7fffffu) | 0x800000u;
+        uint32_t v = mant >> shift;
+        const uint32_t rem = mant & ((1u << shift) - 1u);
+        const uint32_t half = 1u << (shift - 1);
+        if (rem > half || (rem == half && (v & 1u))) v++;
+        return static_cast<uint16_t>(sign | v);
+    }
+    // normal: rebias exponent (127 -> 15), round mantissa to 10 bits RNE;
+    // a mantissa carry correctly bumps the exponent (and 65520+ -> inf).
+    const uint32_t exp16 = (x >> 23) - 112u;
+    const uint32_t mant = x & 0x7fffffu;
+    uint32_t v = (exp16 << 10) | (mant >> 13);
+    const uint32_t rem = mant & 0x1fffu;
+    if (rem > 0x1000u || (rem == 0x1000u && (v & 1u))) v++;
+    return static_cast<uint16_t>(sign | v);
+}
+
+
+#if defined(__x86_64__)
+// Hardware float->half for the wire format; only called after a runtime
+// cpuid check, so the .so stays loadable on pre-F16C machines.
+__attribute__((target("f16c,avx")))
+static void f32_to_f16_vec_hw(const float* in, uint16_t* out, int64_t n) {
+    int64_t i = 0;
+    for (; i + 8 <= n; i += 8) {
+        __m256 v = _mm256_loadu_ps(in + i);
+        __m128i h = _mm256_cvtps_ph(v, _MM_FROUND_TO_NEAREST_INT);
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(out + i), h);
+    }
+    for (; i < n; ++i) out[i] = f32_to_f16_rne(in[i]);
+}
+static bool f16c_supported() {
+    static const bool ok = __builtin_cpu_supports("f16c") &&
+                           __builtin_cpu_supports("avx");
+    return ok;
+}
+#else
+static bool f16c_supported() { return false; }
+static void f32_to_f16_vec_hw(const float*, uint16_t*, int64_t) {}
+#endif
+
+static void f32_to_f16_vec(const float* in, uint16_t* out, int64_t n) {
+    if (f16c_supported()) { f32_to_f16_vec_hw(in, out, n); return; }
+    for (int64_t i = 0; i < n; ++i) out[i] = f32_to_f16_rne(in[i]);
+}
+
+void rn_downsample_stages(const float* batch, int64_t D, int64_t N,
+                          const int32_t* imin, const int32_t* imax,
+                          const float* wmin, const float* wmax,
+                          const float* wint, int64_t S, int64_t nout,
+                          int64_t nthreads, int as_f16, void* out) {
+    std::vector<double> cs((N + 1) * D);
+    std::vector<std::thread> pool;
+    if (nthreads <= 0) nthreads = 1;
+    // phase 1: per-trial prefix sums
+    std::atomic<int64_t> next_d(0);
+    for (int64_t t = 0; t < std::min<int64_t>(nthreads, D); ++t) {
+        pool.emplace_back([&]() {
+            int64_t d;
+            while ((d = next_d.fetch_add(1)) < D) {
+                const float* x = batch + d * N;
+                double* c = cs.data() + d * (N + 1);
+                double acc = 0.0;
+                c[0] = 0.0;
+                for (int64_t i = 0; i < N; ++i) { acc += x[i]; c[i + 1] = acc; }
+            }
+        });
+    }
+    for (auto& th : pool) th.join();
+    pool.clear();
+    // phase 2: stages x trials
+    std::atomic<int64_t> next_job(0);
+    const int64_t njobs = S * D;
+    for (int64_t t = 0; t < std::min<int64_t>(nthreads, njobs); ++t) {
+        pool.emplace_back([&]() {
+            std::vector<float> scratch;
+            int64_t job;
+            while ((job = next_job.fetch_add(1)) < njobs) {
+                const int64_t s = job / D, d = job % D;
+                const float* x = batch + d * N;
+                const double* c = cs.data() + d * (N + 1);
+                const int32_t* a = imin + s * nout;
+                const int32_t* b = imax + s * nout;
+                const float* w0 = wmin + s * nout;
+                const float* w1 = wmax + s * nout;
+                const float* wi = wint + s * nout;
+                const int64_t base = (s * D + d) * nout;
+                if (as_f16) {
+                    uint16_t* o = static_cast<uint16_t*>(out) + base;
+                    scratch.resize(nout);
+                    for (int64_t k = 0; k < nout; ++k) {
+                        const double v = double(w0[k]) * x[a[k]]
+                            + double(wi[k]) * (c[b[k]] - c[a[k] + 1])
+                            + double(w1[k]) * x[b[k]];
+                        scratch[k] = static_cast<float>(v);
+                    }
+                    f32_to_f16_vec(scratch.data(), o, nout);
+                } else {
+                    float* o = static_cast<float*>(out) + base;
+                    for (int64_t k = 0; k < nout; ++k) {
+                        const double v = double(w0[k]) * x[a[k]]
+                            + double(wi[k]) * (c[b[k]] - c[a[k] + 1])
+                            + double(w1[k]) * x[b[k]];
+                        o[k] = static_cast<float>(v);
+                    }
+                }
+            }
+        });
+    }
+    for (auto& th : pool) th.join();
 }
 
 }  // extern "C"

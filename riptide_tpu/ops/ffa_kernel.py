@@ -36,7 +36,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .slottables import (A_SHIFT, A_BITS, B_SHIFT, B_BITS, NAT_LEVELS,
-                         build_tables)
+                         PH_BITS, PH_MASK, build_tables)
 
 __all__ = ["ffa_snr_cycle", "NWPAD"]
 
@@ -56,7 +56,7 @@ def _lane_up(x, c, P):
 
 
 def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
-            *, L, NL, rows, P, RS, widths, nspread):
+            *, L, NL, rows, P, RS, widths, nspread, pbits):
     d = pl.program_id(0)  # DM-trial index (tables are shared across it)
     b = pl.program_id(1)  # bins-trial index
     p = scal[b, 0]
@@ -105,7 +105,8 @@ def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
         tail = jnp.zeros((rows, P), jnp.float32)
         for bv in range(0, (1 << (l - 1)) + 2):
             tail = jnp.where(bf == bv, _roll_r(sv, 1 - bv, rows), tail)
-        tail = tail_wrap(tail, w & 0x1FF, (w >> 9) & 0x1FF, min(l, 9))
+        tail = tail_wrap(tail, w & PH_MASK, (w >> PH_BITS) & PH_MASK,
+                         min(l, pbits))
         dst[:] = jnp.where(
             valid & colmask,
             dst[:] + jnp.where(lone, 0.0, tail),
@@ -155,7 +156,8 @@ def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
             cand = pltpu.roll(rept, (-delta) % S_d, axis=1)
             tail = jnp.where(db == dv, cand, tail)
         tail = tail.reshape(rows, P)
-        tail = tail_wrap(tail, w & 0x1FF, (w >> 9) & 0x1FF, min(l, 9))
+        tail = tail_wrap(tail, w & PH_MASK, (w >> PH_BITS) & PH_MASK,
+                         min(l, pbits))
         dst[:] = jnp.where((w < 0) & colmask, dst[:] + tail, 0.0)
         cur = 1 - cur
 
@@ -169,7 +171,7 @@ def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
     xv = src[:]
     ccols = cols
     cs = xv
-    for k in range(9):
+    for k in range(PH_BITS):
         if (1 << k) >= P:
             break
         sh = jnp.where(ccols >= (1 << k), pltpu.roll(cs, 1 << k, axis=1), 0.0)
@@ -219,10 +221,10 @@ def _pack_coef(ps, widths, hcoef, bcoef, stdnoise):
 
 
 @functools.lru_cache(maxsize=64)
-def _build_call(L, NL, rows, P, RS, widths, nspread, D, B, interpret):
+def _build_call(L, NL, rows, P, RS, widths, nspread, pbits, D, B, interpret):
     kern = functools.partial(
         _kernel, L=L, NL=NL, rows=rows, P=P, RS=RS,
-        widths=widths, nspread=nspread,
+        widths=widths, nspread=nspread, pbits=pbits,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
@@ -274,13 +276,14 @@ class CycleKernel:
         ms = [int(m) for m in ms]
         ps = [int(p) for p in ps]
         widths = tuple(int(w) for w in widths)
-        # The packed-word layout carries sigma/thr in 9-bit fields and the
-        # boxcar prefix scan covers a 512-lane window, so p is capped at
-        # 511 (callers fall back to the XLA gather path beyond it).
-        if max(ps) > 511:
+        # The packed-word layout carries sigma/thr in PH_BITS-wide fields
+        # and the boxcar prefix scan covers a 2**PH_BITS-lane window, so
+        # p is capped at PH_MASK (callers fall back to the XLA gather
+        # path beyond it).
+        if max(ps) > PH_MASK:
             raise ValueError(
-                f"CycleKernel supports p <= 511 (9-bit packed phase "
-                f"fields); got max p = {max(ps)}"
+                f"CycleKernel supports p <= {PH_MASK} ({PH_BITS}-bit "
+                f"packed phase fields); got max p = {max(ps)}"
             )
         # One static width ladder serves the whole bucket: every width
         # must be a valid trial for the smallest problem, mirroring the
@@ -297,6 +300,10 @@ class CycleKernel:
         self.rows = rows = 1 << L
         pmax = max(ps)
         self.P = P = ((pmax + 127) // 128) * 128
+        # Wrap-barrel bit count: sigma mod p < pmax, so only the bits of
+        # pmax-1 ever select a roll; PH_BITS-wide loops would waste
+        # passes for small-p buckets.
+        self.pbits = (pmax - 1).bit_length()
         # RS == rows always: Mosaic cannot compile sublane slices of the
         # VMEM scratch at a smaller tile count (SIGABRT, `limits[i] <=
         # dim(i)`), so the kernel evaluates S/N for every container row
@@ -344,8 +351,8 @@ class CycleKernel:
         if squeeze:
             x = x[None]
         call = _build_call(self.L, self.NL, self.rows, self.P, self.RS,
-                           self.widths, self.nspread, x.shape[0], self.B,
-                           self.interpret)
+                           self.widths, self.nspread, self.pbits,
+                           x.shape[0], self.B, self.interpret)
         out = call(scal, coef, x, wrep)
         return out[0] if squeeze else out
 

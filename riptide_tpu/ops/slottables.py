@@ -45,21 +45,23 @@ NAT_LEVELS = 3      # levels executed in natural layout
 SLOT_S = 8          # slot size after the spread (2**NAT_LEVELS)
 
 # packed word layout (int32):
-#   bits 0-8   sigma mod p            (lane roll;  < p <= 511)
-#   bits 9-17  thr = p - sigma mod p  (wrap-select threshold, 1..511)
-#   bits 18-20 field A: natural phase: head row drift  s - h(s)   in [0,7]
+#   bits 0-10  sigma mod p            (lane roll;  < p <= 2047)
+#   bits 11-21 thr = p - sigma mod p  (wrap-select threshold, 1..2047)
+#   bits 22-24 field A: natural phase: head row drift  s - h(s)   in [0,7]
 #              slot phase:    delta_h + 2                          in [0,3]
-#   bits 21-24 field B: natural phase: tail row offset  (biased)   in [0,15]
+#   bits 25-28 field B: natural phase: tail row offset  (biased)   in [0,15]
 #              slot phase:    delta_t + 2                          in [0,3]
 #   bit  31    valid (sign bit)
-A_SHIFT, A_BITS = 18, 3
-B_SHIFT, B_BITS = 21, 4
+PH_BITS = 11           # sigma / thr field width; bins cap = 2**PH_BITS - 1
+PH_MASK = (1 << PH_BITS) - 1
+A_SHIFT, A_BITS = 2 * PH_BITS, 3
+B_SHIFT, B_BITS = 2 * PH_BITS + A_BITS, 4
 
 
 def pack_word(sigma_mod, thr, a, b, valid):
     w = (
-        (sigma_mod & 0x1FF)
-        | ((thr & 0x1FF) << 9)
+        (sigma_mod & PH_MASK)
+        | ((thr & PH_MASK) << PH_BITS)
         | ((a & ((1 << A_BITS) - 1)) << A_SHIFT)
         | ((b & ((1 << B_BITS) - 1)) << B_SHIFT)
     )
@@ -90,11 +92,13 @@ def _merge_tables(mn):
 def build_tables(m, p, L=None):
     """Build all kernel tables for one (m, p) problem at bucket depth L."""
     m, p = int(m), int(p)
-    if not 0 < p <= 511:
-        # sigma/thr live in 9-bit packed fields and the kernel's boxcar
-        # prefix scan covers a 512-lane window; beyond that the packed
-        # words silently truncate, so refuse loudly.
-        raise ValueError(f"packed-word layout requires 0 < p <= 511, got {p}")
+    if not 0 < p <= PH_MASK:
+        # sigma/thr live in PH_BITS-wide packed fields and the kernel's
+        # boxcar prefix scan covers a 2**PH_BITS-lane window; beyond that
+        # the packed words silently truncate, so refuse loudly.
+        raise ValueError(
+            f"packed-word layout requires 0 < p <= {PH_MASK}, got {p}"
+        )
     Lmin = num_levels(m)
     L = Lmin if L is None else int(L)
     assert L >= Lmin
@@ -243,10 +247,10 @@ def _row_roll(x, c):
 
 def _tail_lane_roll(tail, words, p, P):
     """Barrel lane roll by sigma-mod-p with the two-pass mod-p wrap."""
-    sigm = (words & 0x1FF).astype(np.int64)
-    thr = ((words >> 9) & 0x1FF).astype(np.int64)
+    sigm = (words & PH_MASK).astype(np.int64)
+    thr = ((words >> PH_BITS) & PH_MASK).astype(np.int64)
     acc = tail
-    for k in range(9):
+    for k in range(PH_BITS):
         if not ((sigm >> k) & 1).any():
             continue
         rolled = _lane_roll(acc, 1 << k)

@@ -30,13 +30,9 @@ from jax.sharding import PartitionSpec as Pspec
 from ..search.engine import (
     _assemble,
     _assemble_device,
-    _ffa_path,
     _kernel_eligible,
     _pack_static,
-    _prefix64,
-    _stage_downsample,
     _stage_operands,
-    _wire_dtype,
 )
 
 __all__ = ["run_periodogram_sharded", "run_search_sharded"]
@@ -66,7 +62,7 @@ def _stage_sharded_call(mesh, st, plan, path, with_bins):
         nw = len(plan.widths)
 
         def local(xd):
-            x = _pack_static(xd, shapes, kern.rows, kern.P)
+            x = _pack_static(xd, 0, st.n, shapes, kern.rows, kern.P)
             return kern(x)[..., :remax, :nw]
 
         fn = jax.jit(jax.shard_map(
@@ -131,16 +127,21 @@ def _queue_stages_sharded(plan, batch, mesh):
                 f"the plan's padded bins-trial count {B}"
             )
 
-    path = _ffa_path()
-    wire = _wire_dtype(path)
-    d64, cs = _prefix64(batch)
+    from ..search.engine import prepare_stage_data
+
+    flat, path = prepare_stage_data(plan, batch)
+    flat_dev = jnp.asarray(flat)  # ONE host->device transfer
     outs = []
+    off = 0
     for st in plan.stages:
-        xd = _stage_downsample(st, d64, cs)
-        if path == "kernel" and not with_bins and _kernel_eligible(st, plan):
-            xd = xd[..., : st.n]  # see engine._queue_stages on padding
+        xd = jax.lax.slice_in_dim(flat_dev, off, off + st.n, axis=1)
+        off += st.n
+        if not (path == "kernel" and not with_bins
+                and _kernel_eligible(st, plan)):
+            xd = jnp.pad(xd.astype(jnp.float32),
+                         [(0, 0), (0, plan.nout - st.n)])
         call = _stage_sharded_call(mesh, st, plan, path, with_bins)
-        outs.append(call(jnp.asarray(xd.astype(wire))))
+        outs.append(call(xd))
     return outs, D
 
 

@@ -9,7 +9,7 @@ execution paths exist per stage:
   pure data movement) followed by the fused Pallas VMEM kernel of
   :mod:`riptide_tpu.ops.ffa_kernel` — the whole FFA merge tree plus the
   boxcar S/N runs without the container ever leaving VMEM.
-* **gather** (CPU / oracle / p > 511 fallback): the round-1 XLA
+* **gather** (CPU / oracle / p > 2047 fallback): the round-1 XLA
   formulation — modular-gather FFA levels + gather-based S/N.
 
 Downsampling runs on the HOST in float64 (one prefix sum + weighted
@@ -119,6 +119,36 @@ def _prefix64(data):
     return data, cs
 
 
+def _ds_pack(plan):
+    """Stacked (S, nout) downsample-plan arrays, cached on the plan."""
+    pk = getattr(plan, "_ds_pack", None)
+    if pk is None:
+        cols = list(zip(*(st.ds_plan for st in plan.stages)))
+        pk = plan._ds_pack = tuple(np.stack(c) for c in cols)
+    return pk
+
+
+def _host_downsample_all(plan, batch, wire):
+    """
+    Every cascade stage's downsampling of a (D, N) batch, as one
+    (S, D, nout) array in the wire dtype. Uses the native threaded
+    runtime when available (this is several seconds of gather-bound
+    numpy per 8-trial 2^23 batch otherwise — the single largest host
+    cost of a search).
+    """
+    from .. import native
+
+    if native.available():
+        imin, imax, wmin, wmax, wint = _ds_pack(plan)
+        return native.downsample_stages(
+            batch, imin, imax, wmin, wmax, wint, dtype=wire
+        )
+    d64, cs = _prefix64(batch)
+    return np.stack(
+        [_stage_downsample(st, d64, cs).astype(wire) for st in plan.stages]
+    )
+
+
 def _peak_plan(plan, tobs, **peak_kwargs):
     """Per-plan cached PeakPlan (shared by the unsharded and sharded
     survey paths so identical inputs reuse one plan)."""
@@ -134,15 +164,17 @@ def _peak_plan(plan, tobs, **peak_kwargs):
     return pp
 
 
-@partial(jax.jit, static_argnames=("shapes", "rows", "P"))
-def _pack_static(xd, shapes, rows, P):
+@partial(jax.jit, static_argnames=("off", "n", "shapes", "rows", "P"))
+def _pack_static(flat, off, n, shapes, rows, P):
     """
-    Static pack: per-problem reshape + zero-pad of a downsampled series
-    into the (..., B, rows, P) float32 kernel container. Pure data
-    movement (no gather): problem b is xd[..., : m*p] viewed as (m, p)
-    then padded. Accepts a float16 wire-format input (see _wire_dtype).
+    Static pack, fused with the stage's slice of the all-stages wire
+    buffer: take flat[..., off : off+n], then per-problem reshape +
+    zero-pad into the (..., B, rows, P) float32 kernel container. Pure
+    data movement (no gather): problem b is xd[..., : m*p] viewed as
+    (m, p) then padded. One dispatch per stage — through the device
+    tunnel, per-dispatch overhead is material.
     """
-    xd = xd.astype(jnp.float32)
+    xd = jax.lax.slice_in_dim(flat, off, off + n, axis=-1).astype(jnp.float32)
     outs = []
     for m, p in shapes:
         seg = xd[..., : m * p].reshape(xd.shape[:-1] + (m, p))
@@ -194,25 +226,40 @@ def _ffa_path():
 
 def _kernel_eligible(st, plan):
     """The fused Pallas kernel serves a stage when its packed-word layout
-    fits: p < 512, <= NWPAD widths, container of at least one sublane
-    tile. Ineligible stages fall back to the gather path per stage."""
+    fits (p <= PH_MASK = 2047), the width ladder fits the coefficient
+    bank, the container is at least one sublane tile, and the working
+    set (~10 (rows, P) f32 buffers of unrolled temporaries) fits VMEM.
+    Ineligible stages fall back to the gather path per stage."""
+    from ..ops.ffa_kernel import PH_MASK
+
+    rows = 1 << st.kernel_depth
+    P = -(-max(st.ps_padded) // 128) * 128
     return (
         st.kernel_depth >= 3
-        and max(st.ps_padded) <= 511
+        and max(st.ps_padded) <= PH_MASK
         and len(plan.widths) <= NWPAD
+        and rows * P * 4 * 10 < 100 * 1024 * 1024
     )
 
 
-def _run_stage(st, xd_dev, plan, path):
-    """Queue one cascade stage on device; returns the raw S/N container
-    (..., B, rows<=R, nw) as an unsynced device array."""
-    if path == "kernel" and _kernel_eligible(st, plan):
-        interpret = jax.default_backend() == "cpu"
-        kern = st.cycle_kernel(interpret=interpret)
-        x = _pack_static(xd_dev, tuple(zip(st.ms_padded, st.ps_padded)),
-                         kern.rows, kern.P)
-        out = kern(x)
-        return out[..., : max(st.rows_eval_max, 1), : len(plan.widths)]
+def _run_stage_kernel(st, flat_dev, off, plan):
+    """Queue one kernel-path cascade stage from the shipped wire buffer;
+    returns the (..., B, rows_eval_max, NW) S/N container unsynced. The
+    raw (B, RS, 128) kernel output is sliced immediately so it can be
+    freed — keeping every stage's raw container alive until assembly
+    costs ~170 MB x stages of HBM and OOMs large DM batches."""
+    interpret = jax.default_backend() == "cpu"
+    kern = st.cycle_kernel(interpret=interpret)
+    x = _pack_static(flat_dev, off, st.n,
+                     tuple(zip(st.ms_padded, st.ps_padded)),
+                     kern.rows, kern.P)
+    out = kern(x)
+    return out[..., : max(st.rows_eval_max, 1), : len(plan.widths)]
+
+
+def _run_stage_gather(st, xd_dev, plan):
+    """Queue one gather-path stage (CPU / fallback); returns
+    (..., B, R, NW) unsynced."""
     ops = _stage_operands(st)
     return _gather_cycle_xd(
         xd_dev, ops["h"], ops["t"], ops["shift"], ops["p"], ops["m"],
@@ -253,7 +300,9 @@ def _assemble(plan, raw_per_stage):
     for st, raw in zip(plan.stages, raw_per_stage):
         for i, re in enumerate(st.rows_eval):
             if re:
-                chunks.append(raw[i, :re, :])
+                # raw may be the kernel's (B, RS, 128) container or the
+                # gather path's (B, R, NW): slice both axes.
+                chunks.append(raw[i, :re, :nw])
     if chunks:
         return np.ascontiguousarray(np.concatenate(chunks, axis=0), dtype=np.float32)
     return np.empty((0, nw), np.float32)
@@ -265,39 +314,94 @@ def _assemble_device(plan, *outs):
     evaluated rows and concatenate in plan trial order, keeping the
     (D, n_trials, NW) S/N cube on the device (for on-device peak
     detection — only KB-sized peak summaries then cross to the host)."""
+    nw = len(plan.widths)
     chunks = []
     for st, raw in zip(plan.stages, outs):
         for i, re in enumerate(st.rows_eval):
             if re:
-                chunks.append(raw[:, i, :re, :])
+                # raw: kernel (D, B, RS, 128) or gather (D, B, R, NW)
+                chunks.append(raw[:, i, :re, :nw])
     return jnp.concatenate(chunks, axis=1)
 
 
-def _queue_stages(plan, batch):
-    """Shared stage loop: host downsampling overlapped with async device
-    queueing. Ships each stage's UNPADDED samples (the cascade's padded
-    plan length nout is up to ~2x the real output size) in the wire
-    dtype. Returns the list of per-stage device outputs."""
+def prepare_stage_data(plan, batch):
+    """
+    HOST half of a batched search: every cascade stage's downsampling of
+    the (D, N) batch, concatenated unpadded into ONE (D, total_samples)
+    wire-dtype array (plus the per-stage offsets). Ships to the device
+    as a single transfer — per-stage transfers each pay the interconnect
+    round-trip latency. Runs in the native threaded runtime when
+    available; callers can invoke this on a worker thread to overlap the
+    next batch's host work with device execution of the current one
+    (ctypes releases the GIL).
+    """
     batch = np.asarray(batch, dtype=np.float32)
     if batch.ndim != 2 or batch.shape[1] != plan.size:
         raise ValueError("batch must be (D, N) with N matching the plan")
     path = _ffa_path()
     wire = _wire_dtype(path)
-    d64, cs = _prefix64(batch)
+    xds = _host_downsample_all(plan, batch, wire)
+    D = batch.shape[0]
+    lens = [st.n for st in plan.stages]
+    flat = np.empty((D, sum(lens)), wire)
+    off = 0
+    for i, st in enumerate(plan.stages):
+        flat[:, off : off + st.n] = xds[i][..., : st.n]
+        off += st.n
+    return flat, path
+
+
+def ship_stage_data(plan, prepared):
+    """Asynchronously ship a prepared wire buffer to the device, in up
+    to 4 chunks cut at stage boundaries (each stage's data lives wholly
+    inside one chunk, so early stages can start while later chunks are
+    in flight). Returns the device parts + stage->(part, offset) map;
+    pass to :func:`run_search_batch` as ``shipped`` to start the next
+    batch's transfer while the current one computes."""
+    flat, path = prepared
+    S = len(plan.stages)
+    starts = np.concatenate([[0], np.cumsum([st.n for st in plan.stages])])
+    nchunks = min(4, S)
+    bounds = [int(round(i * S / nchunks)) for i in range(nchunks + 1)]
+    parts = []
+    part_of = {}
+    for c, (a, b) in enumerate(zip(bounds, bounds[1:])):
+        parts.append(jnp.asarray(flat[..., int(starts[a]) : int(starts[b])]))
+        for i in range(a, b):
+            part_of[i] = (c, int(starts[i] - starts[a]))
+    return parts, part_of, path
+
+
+def _queue_stages(plan, batch, prepared=None, shipped=None):
+    """Queue every cascade stage on device, from (in order of
+    precedence) already-shipped device parts, a prepared host wire
+    buffer, or the raw batch. Each stage runs as two dispatches (fused
+    slice+pack, kernel)."""
+    if shipped is None:
+        if prepared is None:
+            prepared = prepare_stage_data(plan, batch)
+        shipped = ship_stage_data(plan, prepared)
+    parts, part_of, path = shipped
+
     outs = []
-    for st in plan.stages:
-        xd = _stage_downsample(st, d64, cs)
+    for i, st in enumerate(plan.stages):
+        c, off = part_of[i]
         if path == "kernel" and _kernel_eligible(st, plan):
-            # Kernel-path programs are keyed by bucket shape, not series
-            # length: ship only the unpadded samples. Gather-path
-            # programs ARE keyed by length — keep the plan-wide padding
-            # so all stages share one compiled program.
-            xd = xd[..., : st.n]
-        outs.append(_run_stage(st, jnp.asarray(xd.astype(wire)), plan, path))
+            outs.append(_run_stage_kernel(st, parts[c], off, plan))
+        else:
+            # Gather-path programs are keyed by series length: restore
+            # the plan-wide padded length so all stages share one
+            # compiled program. Also promote a float16 wire back to
+            # float32 — the gather path accumulates in its input dtype.
+            xd = jax.lax.slice_in_dim(parts[c], off, off + st.n, axis=-1)
+            xd = jnp.pad(xd.astype(jnp.float32),
+                         [(0, 0), (0, plan.nout - st.n)])
+            outs.append(_run_stage_gather(st, xd, plan))
     return outs
 
 
-def run_search_batch(plan, batch, tobs, dms=None, **peak_kwargs):
+def run_search_batch(plan, batch, tobs, dms=None, prepared=None,
+                     shipped=None, **peak_kwargs):
     """
     Full batched search with ON-DEVICE peak detection: periodogram
     stages -> device-side assembly -> device thresholding/selection ->
@@ -314,7 +418,7 @@ def run_search_batch(plan, batch, tobs, dms=None, **peak_kwargs):
     if dms is None:
         dms = np.zeros(D)
     pp = _peak_plan(plan, tobs, **peak_kwargs)
-    outs = _queue_stages(plan, batch)
+    outs = _queue_stages(plan, batch, prepared=prepared, shipped=shipped)
     snr_dev = _assemble_device(plan, *outs)
     return device_find_peaks(pp, snr_dev, dms)
 
@@ -331,18 +435,10 @@ def run_periodogram(plan, data):
     data = np.asarray(data, dtype=np.float32)
     if data.size != plan.size:
         raise ValueError("data length does not match plan size")
-    path = _ffa_path()
-    wire = _wire_dtype(path)
-    d64, cs = _prefix64(data)
-    outs = []
-    for st in plan.stages:
-        xd = _stage_downsample(st, d64, cs)
-        if path == "kernel" and _kernel_eligible(st, plan):
-            xd = xd[: st.n]  # see _queue_stages on padding vs compiles
-        outs.append(_run_stage(st, jnp.asarray(xd.astype(wire)), plan, path))
+    outs = _queue_stages(plan, data[None])
     # One host sync at the end: device work for all cycles is queued
     # asynchronously, then gathered.
-    raw = [np.asarray(o) for o in outs]
+    raw = [np.asarray(o)[0] for o in outs]
     snrs = _assemble(plan, raw)
     return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs
 
@@ -367,10 +463,11 @@ def run_periodogram_batch(plan, batch):
 
     Returns (periods, foldbins, snrs (D, len, NW)).
     """
-    # Stage-wise: downsample stage i for the whole batch on the host,
-    # ship it, queue the device stage, then move to stage i+1 — so host
-    # prep of later stages genuinely overlaps device execution of
-    # earlier ones (device calls are asynchronous).
+    # Host wire preparation runs to completion first (natively threaded),
+    # then device stages queue asynchronously; callers wanting
+    # host/device overlap run prepare_stage_data / ship_stage_data for
+    # the NEXT batch while this one computes (see pipeline.batcher and
+    # bench.py).
     outs = _queue_stages(plan, batch)
     D = np.asarray(batch).shape[0]
     raw = [np.asarray(o) for o in outs]  # (D, B, rows<=R, NW) each

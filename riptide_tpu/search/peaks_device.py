@@ -11,19 +11,19 @@ S/N cube on the device; only kilobyte-sized summaries cross to the host:
 2. host: exact float64 ``np.polyfit`` of the threshold control points
    (identical math to the reference, which uses float64 numpy);
 3. device: dynamic threshold evaluated from the fitted coefficients,
-   mask ``s > max(dynthr, smin)`` widened by a small epsilon, first-K
-   selected (trial index, S/N) pairs per (D, width) -> the only other
-   pull, K * 8 bytes per column;
-4. host: exact threshold re-check in float64 on the pulled points (the
-   epsilon margin absorbs device float32 rounding), then the reference's
-   friends-of-friends clustering + per-cluster argmax -> Peak tuples.
+   mask ``s > max(dynthr, smin)`` widened by a small epsilon, then
+   per-512-trial-block SELECTED COUNTS -> a ~100 KB pull;
+4. host: picks the non-empty blocks and issues ONE bucketed gather of
+   just those blocks' S/N values (KB-scale), then the exact float64
+   threshold re-check (the epsilon margin absorbs device float32
+   rounding) and the reference's friends-of-friends clustering +
+   per-cluster argmax -> Peak tuples.
 
-The devil in (3): candidate counts are data-dependent, so the device
-emits a fixed-size buffer of the K selected points with the SMALLEST
-trial indices (order statistics over masked indices via top_k), plus the
-true selected count for overflow detection. K defaults high enough that
-real searches never overflow; on overflow the affected column falls back
-to pulling its full S/N column.
+Candidate counts are data-dependent; blocks make the device outputs
+fixed-shape (counts per block), while the host-driven gather is padded
+to a power-of-two bucket so repeated batches reuse a handful of
+compiled programs. Unlike a fixed top-K buffer there is no overflow
+case — every selected point always reaches the host.
 """
 import logging
 
@@ -50,7 +50,7 @@ class PeakPlan:
     periodogram plan + observation length."""
 
     def __init__(self, plan, tobs, smin=6.0, segwidth=5.0, nstd=6.0,
-                 minseg=10, polydeg=2, clrad=0.1, K=4096):
+                 minseg=10, polydeg=2, clrad=0.1):
         freqs = 1.0 / plan.all_periods  # decreasing, like Periodogram.freqs
         n = freqs.size
         w = segwidth / tobs
@@ -63,7 +63,6 @@ class PeakPlan:
         self.minseg = int(minseg)
         self.polydeg = int(polydeg)
         self.clrad = float(clrad)
-        self.K = int(min(K, n))
         self.n = n
         self.nseg = nseg
         self.pts = pts
@@ -107,18 +106,25 @@ class PeakPlan:
                 ).coefficients
         return polyco
 
-    # -- step 3: device mask + first-K selection -------------------------
+    # -- step 3: device mask + block-count, host-driven block gather -----
+    #
+    # Selected points are sparse (tens to hundreds of 2e5 trials). The
+    # trial axis is cut into BLK-sample blocks; the device returns only
+    # per-block selected COUNTS (a ~100 KB pull), the host picks the
+    # non-empty blocks, and one bucketed gather pulls just those blocks'
+    # S/N values. No scatter/sort over the full axis (XLA's lowering of
+    # either costs seconds per batch at this width).
+
+    BLK = 512
+
+    @property
+    def _nb(self):
+        return -(-self.n // self.BLK)
 
     @partial(jax.jit, static_argnames=("self",))
-    def _select(self, snr, polyco):
+    def _block_counts(self, snr, polyco):
         """snr (D, n, NW), polyco (D, NW, deg+1) f32 ->
-        idx (D, NW, K) int32, val (D, NW, K) f32, count (D, NW) int32.
-
-        First-K compaction by cumsum + scatter-add: each selected point's
-        output slot is its rank among selected points (selected points
-        land on distinct slots; unselected add zero). top_k/sort over the
-        full n=2e5 axis is avoided deliberately — XLA's large-k sorting
-        networks take minutes to compile at this width."""
+        cnt (D, NW, nb) int32 of threshold-selected points per block."""
         logf = jnp.asarray(self.logf)
         # Horner evaluation of the threshold polynomial at every trial.
         thr = jnp.zeros(polyco.shape[:2] + (self.n,), jnp.float32)
@@ -126,28 +132,28 @@ class PeakPlan:
             thr = thr * logf[None, None, :] + polyco[:, :, k, None]
         s = snr.transpose(0, 2, 1)  # (D, NW, n)
         mask = (s > thr - EPS) & (s > self.smin - EPS)
-        count = mask.sum(axis=-1).astype(jnp.int32)
         D, NW, n = s.shape
-        pos = jnp.cumsum(mask, axis=-1) - 1           # rank of each point
-        ok = mask & (pos < self.K)
-        posc = jnp.clip(pos, 0, self.K - 1)
-        dd = jnp.arange(D)[:, None, None]
-        ww = jnp.arange(NW)[None, :, None]
-        iota = jnp.arange(n, dtype=jnp.int32)[None, None, :]
-        zeros = jnp.zeros((D, NW, self.K), jnp.float32)
-        idx = zeros.astype(jnp.int32).at[dd, ww, posc].add(
-            jnp.where(ok, iota, 0)
-        )
-        val = zeros.at[dd, ww, posc].add(jnp.where(ok, s, 0.0))
-        slot = jnp.arange(self.K)[None, None, :]
-        valid = slot < jnp.minimum(count, self.K)[..., None]
-        return idx, jnp.where(valid, val, -jnp.inf), count
+        pad = self._nb * self.BLK - n
+        mask = jnp.pad(mask, [(0, 0), (0, 0), (0, pad)])
+        return mask.reshape(D, NW, self._nb, self.BLK).sum(-1).astype(jnp.int32)
+
+    @partial(jax.jit, static_argnames=("self", "nblocks"))
+    def _gather_blocks(self, snr, flat_ids, nblocks):
+        """Gather ``nblocks`` (d, iw, block) rows of BLK S/N values.
+        flat_ids: (nblocks,) int32 = (d * NW + iw) * nb + b."""
+        D, n, NW = snr.shape
+        s = snr.transpose(0, 2, 1)
+        pad = self._nb * self.BLK - n
+        s = jnp.pad(s, [(0, 0), (0, 0), (0, pad)],
+                    constant_values=-jnp.inf)
+        flat = s.reshape(D * NW * self._nb, self.BLK)
+        return jnp.take(flat, flat_ids, axis=0)
 
     # -- step 4: host exact threshold + clustering -----------------------
 
-    def _finalize(self, idx, val, count, polyco, widths, foldbins, dms,
-                  snr_dev=None):
-        D, NW = count.shape
+    def _finalize(self, cols, polyco, widths, foldbins, dms, D, NW):
+        """cols: dict (d, iw) -> (trial indices int64, S/N float64) of
+        every device-selected point in that column."""
         peaks_per_trial = [[] for _ in range(D)]
         polycos = [{} for _ in range(D)]
         logf64 = np.log(self.freqs)
@@ -156,24 +162,9 @@ class PeakPlan:
                 pc = polyco[d, iw]
                 poly = np.poly1d(pc if self.nseg >= self.minseg else [self.smin])
                 polycos[d][iw] = poly.coefficients
-                k = min(int(count[d, iw]), self.K)
-                if k == 0:
+                if (d, iw) not in cols:
                     continue
-                if count[d, iw] > self.K and snr_dev is not None:
-                    # Buffer overflow (heavy RFI): fall back to pulling
-                    # this one column's full S/N and selecting on host.
-                    log.warning(
-                        "peak buffer overflow (%d > K=%d) for trial %d "
-                        "width %d; pulling the full S/N column",
-                        count[d, iw], self.K, d, widths[iw],
-                    )
-                    sfull = np.asarray(snr_dev[d, :, iw], dtype=np.float64)
-                    keep_full = (sfull > poly(logf64)) & (sfull > self.smin)
-                    ix = np.where(keep_full)[0]
-                    sv = sfull[ix]
-                else:
-                    ix = np.asarray(idx[d, iw, :k], dtype=np.int64)
-                    sv = np.asarray(val[d, iw, :k], dtype=np.float64)
+                ix, sv = cols[(d, iw)]
                 # exact float64 re-check (the device applied thr - EPS)
                 keep = (sv > poly(logf64[ix])) & (sv > self.smin)
                 ix, sv = ix[keep], sv[keep]
@@ -217,11 +208,40 @@ def device_find_peaks(peak_plan, snr_dev, dms):
     snr_dev = jnp.asarray(snr_dev)
     stats = np.asarray(peak_plan._stats(snr_dev))          # pull ~100 KB
     polyco = peak_plan._fit(stats)
-    idx, val, count = peak_plan._select(
+    cnt = np.asarray(peak_plan._block_counts(
         snr_dev, jnp.asarray(polyco, dtype=jnp.float32)
-    )
-    idx, val, count = np.asarray(idx), np.asarray(val), np.asarray(count)
+    ))
+    D, NW, nb = cnt.shape
+    sel = np.argwhere(cnt > 0)
+    cols = {}
+    if sel.size:
+        flat_ids = ((sel[:, 0] * NW + sel[:, 1]) * nb + sel[:, 2]).astype(
+            np.int32
+        )
+        # Bucket the gather size so repeated batches reuse a handful of
+        # compiled programs instead of one per data-dependent count.
+        bucket = max(64, 1 << int(np.ceil(np.log2(len(flat_ids)))))
+        padded = np.zeros(bucket, np.int32)
+        padded[: len(flat_ids)] = flat_ids
+        vals = np.asarray(peak_plan._gather_blocks(
+            snr_dev, jnp.asarray(padded), bucket
+        ))[: len(flat_ids)].astype(np.float64)
+        BLK = peak_plan.BLK
+        off = np.arange(BLK)
+        for row, (d, iw, b) in zip(vals, sel):
+            pos = b * BLK + off
+            ok = pos < peak_plan.n
+            # every point of a selected block comes home; the exact
+            # float64 threshold cut happens in _finalize
+            ix = pos[ok]
+            sv = row[ok]
+            key = (int(d), int(iw))
+            if key in cols:
+                pix, psv = cols[key]
+                cols[key] = (np.concatenate([pix, ix]),
+                             np.concatenate([psv, sv]))
+            else:
+                cols[key] = (ix.astype(np.int64), sv)
     return peak_plan._finalize(
-        idx, val, count, polyco, plan.widths, plan.all_foldbins, dms,
-        snr_dev=snr_dev,
+        cols, polyco, plan.widths, plan.all_foldbins, dms, D, NW
     )

@@ -86,6 +86,8 @@ def _check_kernel(k, out, ms, ps, datas, widths, std):
     ([100], [130]),                    # p > 128 (two lane tiles)
     ([37, 29, 1], [33, 40, 33]),       # mixed bucket incl. m=1 padding
     ([250, 240, 230], [240, 250, 260]),  # production-style bins trial batch
+    ([40, 38], [500, 520]),            # reference range-2 bins (p > 511)
+    ([17], [1040]),                    # reference range-3 bins
 ])
 def test_cycle_kernel_interpret_matches_oracle(ms, ps):
     widths = (1, 2, 3, 4, 6, 9, 13)
@@ -117,10 +119,10 @@ def test_cycle_kernel_validation():
     h = np.ones((1, 2), np.float32)
     b = np.ones((1, 2), np.float32)
     std = np.ones(1, np.float32)
-    with pytest.raises(ValueError, match="p <= 511"):
-        CycleKernel([100], [600], (1, 2), h, b, std)
-    with pytest.raises(ValueError, match="p <= 511"):
-        build_tables(100, 600)
+    with pytest.raises(ValueError, match="p <= 2047"):
+        CycleKernel([100], [3000], (1, 2), h, b, std)
+    with pytest.raises(ValueError, match="p <= 2047"):
+        build_tables(100, 3000)
     with pytest.raises(ValueError, match="widths"):
         CycleKernel([100], [64], (1, 64), h, b, std)  # w >= min(p)
     many = tuple(range(1, NWPAD + 2))

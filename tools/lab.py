@@ -1,0 +1,145 @@
+"""Persistent TPU lab: warm the bench programs once, then execute timing
+commands from /tmp/lab_cmd (one word per line appended; results appended
+to /tmp/lab_log). Avoids paying the ~15 min Mosaic compile per
+experiment (the compile cache cannot persist Pallas executables).
+
+Commands: prep | ship | stages | assemble | stats | select | finalize |
+full | pull1 | exit
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/riptide_tpu_jax_cache")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from riptide_tpu.ffautils import generate_width_trials
+from riptide_tpu.search import periodogram_plan
+from riptide_tpu.search.engine import (
+    _assemble_device, _peak_plan, _queue_stages, prepare_stage_data,
+    run_search_batch,
+)
+
+N = 1 << 23
+TSAMP = 64e-6
+D = int(os.environ.get("LAB_D", "32"))
+PKW = dict(smin=7.0, segwidth=5.0, nstd=6.0, minseg=10, polydeg=2, clrad=0.1)
+
+CMD, LOG = "/tmp/lab_cmd", "/tmp/lab_log"
+
+
+def log(msg):
+    with open(LOG, "a") as f:
+        f.write(f"{time.strftime('%H:%M:%S')} {msg}\n")
+
+
+def sync(x):
+    """True device sync: fetch one element."""
+    return float(np.asarray(jax.numpy.ravel(x)[0]))
+
+
+def main():
+    widths = tuple(int(w) for w in generate_width_trials(240))
+    plan = periodogram_plan(N, TSAMP, widths, 0.5, 3.0, 240, 260)
+    tobs = N * TSAMP
+    rng = np.random.default_rng(0)
+    batch = rng.standard_normal((D, N), dtype=np.float32)
+    log(f"lab starting: D={D}, warming...")
+    t0 = time.perf_counter()
+    run_search_batch(plan, batch, tobs=tobs, **PKW)
+    log(f"warm done in {time.perf_counter()-t0:.1f}s; ready")
+
+    state = {}
+    pos = 0
+    while True:
+        time.sleep(2.0)
+        if not os.path.exists(CMD):
+            continue
+        with open(CMD) as f:
+            lines = f.read().splitlines()
+        new = lines[pos:]
+        pos = len(lines)
+        for cmd in new:
+            cmd = cmd.strip()
+            if not cmd:
+                continue
+            t0 = time.perf_counter()
+            try:
+                if cmd == "exit":
+                    log("bye")
+                    return
+                elif cmd == "prep":
+                    state["prep"] = prepare_stage_data(plan, batch)
+                elif cmd == "ship":
+                    prep = state.get("prep") or prepare_stage_data(plan, batch)
+                    state["prep"] = prep
+                    t0 = time.perf_counter()
+                    dev = jnp.asarray(prep[0])
+                    sync(dev)
+                elif cmd == "stages":
+                    t0 = time.perf_counter()
+                    outs = _queue_stages(plan, batch, state.get("prep"))
+                    sync(outs[-1])
+                    state["outs"] = outs
+                elif cmd == "assemble":
+                    outs = state["outs"]
+                    t0 = time.perf_counter()
+                    snr = _assemble_device(plan, *outs)
+                    sync(snr)
+                    state["snr"] = snr
+                elif cmd == "stats":
+                    pp = _peak_plan(plan, tobs, **PKW)
+                    snr = state["snr"]
+                    t0 = time.perf_counter()
+                    stats = np.asarray(pp._stats(snr))
+                    state["stats"] = stats
+                    state["pp"] = pp
+                elif cmd == "select":
+                    pp, snr = state["pp"], state["snr"]
+                    polyco = pp._fit(state["stats"])
+                    state["polyco"] = polyco
+                    t0 = time.perf_counter()
+                    cnt = np.asarray(pp._block_counts(
+                        snr, jnp.asarray(polyco, jnp.float32)))
+                    state["cnt"] = cnt
+                elif cmd == "finalize":
+                    from riptide_tpu.search.peaks_device import (
+                        device_find_peaks,
+                    )
+                    pp, snr = state["pp"], state["snr"]
+                    t0 = time.perf_counter()
+                    device_find_peaks(pp, snr, np.zeros(D))
+                elif cmd == "full":
+                    t0 = time.perf_counter()
+                    run_search_batch(plan, batch, tobs=tobs, **PKW)
+                elif cmd == "pull1":
+                    snr = state["snr"]
+                    t0 = time.perf_counter()
+                    np.asarray(snr[0])
+                elif cmd.startswith("exec "):
+                    # arbitrary experiment: exec a python file in this
+                    # process's context (plan/batch/state in scope)
+                    path = cmd.split(None, 1)[1]
+                    src = open(path).read()
+                    t0 = time.perf_counter()
+                    exec(compile(src, path, "exec"), {
+                        "np": np, "jnp": jnp, "jax": jax, "time": time,
+                        "plan": plan, "batch": batch, "state": state,
+                        "tobs": tobs, "PKW": PKW, "log": log, "sync": sync,
+                        "D": D,
+                    })
+                else:
+                    log(f"{cmd}: unknown")
+                    continue
+                log(f"{cmd}: {time.perf_counter()-t0:.3f}s")
+            except Exception as err:
+                log(f"{cmd}: ERROR {err!r}")
+
+
+if __name__ == "__main__":
+    main()
