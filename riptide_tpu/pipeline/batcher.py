@@ -93,17 +93,22 @@ class BatchSearcher:
         Returns a flat list of Peaks."""
         chunks = [list(c) for c in fname_chunks]
         peaks = []
-        with ThreadPoolExecutor(max_workers=self.io_threads) as ex:
+        # Two pools: `stager` runs the one-per-chunk prepare task, and
+        # `loaders` parallelises the file loads INSIDE it. (One shared
+        # pool would deadlock at io_threads=1: the staging task would
+        # occupy the only worker while waiting on its own load futures.)
+        with ThreadPoolExecutor(max_workers=1) as stager, \
+                ThreadPoolExecutor(max_workers=self.io_threads) as loaders:
 
             def stage_chunk(fnames):
-                tslist = list(ex.map(self.load_prepared, fnames))
+                tslist = list(loaders.map(self.load_prepared, fnames))
                 return self._prepare_chunk(tslist)
 
-            pending = ex.submit(stage_chunk, chunks[0]) if chunks else None
+            pending = stager.submit(stage_chunk, chunks[0]) if chunks else None
             for i, chunk in enumerate(chunks):
                 items = pending.result()
                 if i + 1 < len(chunks):
-                    pending = ex.submit(stage_chunk, chunks[i + 1])
+                    pending = stager.submit(stage_chunk, chunks[i + 1])
                 peaks.extend(self._execute_chunk(items))
                 log.debug(
                     f"Chunk {i + 1}/{len(chunks)} ({len(chunk)} files) done, "

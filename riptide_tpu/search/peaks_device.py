@@ -137,8 +137,8 @@ class PeakPlan:
         mask = jnp.pad(mask, [(0, 0), (0, 0), (0, pad)])
         return mask.reshape(D, NW, self._nb, self.BLK).sum(-1).astype(jnp.int32)
 
-    @partial(jax.jit, static_argnames=("self", "nblocks"))
-    def _gather_blocks(self, snr, flat_ids, nblocks):
+    @partial(jax.jit, static_argnames=("self",))
+    def _gather_blocks(self, snr, flat_ids):
         """Gather ``nblocks`` (d, iw, block) rows of BLK S/N values.
         flat_ids: (nblocks,) int32 = (d * NW + iw) * nb + b."""
         D, n, NW = snr.shape
@@ -224,7 +224,7 @@ def device_find_peaks(peak_plan, snr_dev, dms):
         padded = np.zeros(bucket, np.int32)
         padded[: len(flat_ids)] = flat_ids
         vals = np.asarray(peak_plan._gather_blocks(
-            snr_dev, jnp.asarray(padded), bucket
+            snr_dev, jnp.asarray(padded)
         ))[: len(flat_ids)].astype(np.float64)
         BLK = peak_plan.BLK
         off = np.arange(BLK)

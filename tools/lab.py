@@ -1,6 +1,6 @@
 """Persistent TPU lab: warm the bench programs once, then execute timing
-commands from /tmp/lab_cmd (one word per line appended; results appended
-to /tmp/lab_log). Avoids paying the ~15 min Mosaic compile per
+commands from ~/.riptide_lab/cmd (one per line appended; results appended
+to ~/.riptide_lab/log; the directory is 0700 since commands are exec'd). Avoids paying the ~15 min Mosaic compile per
 experiment (the compile cache cannot persist Pallas executables).
 
 Commands: prep | ship | stages | assemble | stats | select | finalize |
@@ -30,7 +30,12 @@ TSAMP = 64e-6
 D = int(os.environ.get("LAB_D", "32"))
 PKW = dict(smin=7.0, segwidth=5.0, nstd=6.0, minseg=10, polydeg=2, clrad=0.1)
 
-CMD, LOG = "/tmp/lab_cmd", "/tmp/lab_log"
+# Command/log files live in a mode-0700 directory: the command file is
+# exec'd, so it must not be world-writable.
+_LAB_DIR = os.path.join(os.path.expanduser("~"), ".riptide_lab")
+os.makedirs(_LAB_DIR, mode=0o700, exist_ok=True)
+os.chmod(_LAB_DIR, 0o700)
+CMD, LOG = os.path.join(_LAB_DIR, "cmd"), os.path.join(_LAB_DIR, "log")
 
 
 def log(msg):
