@@ -139,8 +139,9 @@ class PeakPlan:
 
     @partial(jax.jit, static_argnames=("self",))
     def _gather_blocks(self, snr, flat_ids):
-        """Gather ``nblocks`` (d, iw, block) rows of BLK S/N values.
-        flat_ids: (nblocks,) int32 = (d * NW + iw) * nb + b."""
+        """Gather the (d, iw, block) rows of BLK S/N values named by
+        flat_ids ((k,) int32 = (d * NW + iw) * nb + b); the compiled
+        program is keyed by flat_ids' bucket-padded length."""
         D, n, NW = snr.shape
         s = snr.transpose(0, 2, 1)
         pad = self._nb * self.BLK - n

@@ -296,3 +296,32 @@ def test_peak_cluster_and_dataframe():
     assert df.iloc[1]["hfrac_num"] == 2
     assert df.iloc[1]["fundamental_rank"] == 0
     assert df.iloc[0]["fundamental_rank"] == 0  # fundamental points at itself
+
+
+def test_batch_searcher_single_io_thread(tmp_path):
+    """Regression: process_stream must not deadlock at io_threads=1
+    (the per-chunk staging task once shared the pool with the file
+    loads it waits on)."""
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+
+    f1 = generate_data_presto(str(tmp_path), "a_DM0.00", tobs=16.0,
+                              tsamp=1e-3, period=0.5, dm=0.0)
+    f2 = generate_data_presto(str(tmp_path), "b_DM5.00", tobs=16.0,
+                              tsamp=1e-3, period=0.5, dm=5.0)
+    conf = [{
+        "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                       "bins_min": 64, "bins_max": 71},
+        "find_peaks": {"smin": 6.0},
+    }]
+    bs = BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101}, conf,
+                       fmt="presto", io_threads=1)
+    # Bounded wait: the failure mode guarded against is an infinite
+    # block, which must fail the test rather than wedge the run.
+    from concurrent.futures import ThreadPoolExecutor as _TPE
+
+    with _TPE(max_workers=1) as runner:
+        fut = runner.submit(bs.process_stream, [[f1], [f2]])
+        peaks = fut.result(timeout=300)
+    assert peaks, "no peaks from the single-io-thread stream"
+    best = max(peaks, key=lambda p: p.snr)
+    assert abs(best.period - 0.5) < 1e-3
