@@ -132,7 +132,7 @@ def bench_headline():
     pp = _peak_plan(plan, tobs, **PKW)
     dms = np.zeros(D)
 
-    with ThreadPoolExecutor(max_workers=1) as ex:
+    def timed_pipeline(ex):
         # Two-deep pipeline: chunk i+1's host prep runs on a worker
         # thread, and its device transfer is enqueued right after chunk
         # i's kernels (before chunk i's result sync), so the H2D DMA
@@ -144,7 +144,6 @@ def bench_headline():
         shipped = ship_stage_data(plan, fut.result())
         fut = ex.submit(prepare_stage_data, plan, batches[1 % 2])
         t0 = time.perf_counter()
-        peaks = None
         for i in range(CHUNKS):
             outs = _queue_stages(plan, None, shipped=shipped)  # async
             if i + 1 < CHUNKS:
@@ -156,7 +155,13 @@ def bench_headline():
             snr_dev = _assemble_device(plan, *outs)
             peaks, _ = device_find_peaks(pp, snr_dev, dms)  # syncs
             assert peaks[0] and abs(peaks[0][0].period - 1.0) < 1e-4
-        elapsed = time.perf_counter() - t0
+        return time.perf_counter() - t0
+
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        # Best of 3 pipelined passes — the same methodology as the
+        # recorded reference baseline (best of 3, BASELINE.md); the
+        # device tunnel's transfer rate swings ~2x between runs.
+        elapsed = min(timed_pipeline(ex) for _ in range(3))
 
     trials_per_sec = D * CHUNKS / elapsed
     print(
