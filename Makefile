@@ -17,6 +17,12 @@ tests-cov:
 	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q \
 		--cov=riptide_tpu --cov-report=term
 
+# Compiled-kernel parity sweep on the REAL TPU (tpu-marked tests only).
+# Run alone — one TPU client at a time; Mosaic compiles of the three
+# production buckets take minutes each on a cold cache.
+tests-tpu:
+	RIPTIDE_TESTS_TPU=1 $(PYTHON) -m pytest tests/ -q -m tpu
+
 # Build the native host library explicitly (it otherwise builds lazily
 # on first use).
 native:

@@ -32,19 +32,20 @@ from .serialization import save_json, load_json
 from .peak_detection import find_peaks, Peak
 from .candidate import Candidate
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 
 def test():
-    """Run the test suite in-process (requires pytest and a repository
-    checkout — the suite lives in <repo>/tests next to the package)."""
+    """Run the test suite in-process (requires pytest). Works from a
+    repository checkout (<repo>/tests) or an installed tree (the suite
+    ships as ``riptide_tpu.tests``), like the reference's in-package
+    tests (riptide/tests/__init__.py:5-10)."""
     import os
     import pytest
 
-    path = os.path.join(os.path.dirname(os.path.dirname(__file__)), "tests")
-    if not os.path.isdir(path):
-        raise RuntimeError(
-            "riptide_tpu.test() requires a repository checkout; "
-            f"no test directory found at {path}"
-        )
-    return pytest.main(["-v", path])
+    here = os.path.dirname(__file__)
+    for path in (os.path.join(os.path.dirname(here), "tests"),
+                 os.path.join(here, "tests")):
+        if os.path.isdir(path):
+            return pytest.main(["-v", path])
+    raise RuntimeError("riptide_tpu.test(): no test directory found")

@@ -27,6 +27,7 @@ __all__ = [
     "running_median",
     "downsample",
     "downsample_stages",
+    "prepare_wire_u12",
     "circular_prefix_sum",
     "boxcar_snr",
 ]
@@ -127,6 +128,17 @@ def _bind(lib):
         _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"),
         c64, c64, c64, ctypes.c_int,              # S, nout, nthreads, as_f16
         ctypes.c_void_p,                          # out (S, D, nout)
+    ]
+    lib.rn_prepare_wire_u12.restype = None
+    lib.rn_prepare_wire_u12.argtypes = [
+        _f32("C_CONTIGUOUS"), c64, c64,           # batch, D, N
+        i32p, i32p,                               # imin, imax (S, nout_pad)
+        _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"),
+        c64, c64,                                 # S, nout_pad
+        i32p, i64p,                               # nouts (S,), boffs (S,)
+        c64, c64,                                 # totbytes, nthreads
+        _f32("C_CONTIGUOUS"),                     # scales out (S, D)
+        ctypes.c_void_p,                          # out (D, totbytes) u8
     ]
     return lib
 
@@ -281,6 +293,41 @@ def downsample_stages(batch, imin, imax, wmin, wmax, wint, dtype=np.float32,
         out.ctypes.data,
     )
     return out
+
+
+def prepare_wire_u12(batch, imin, imax, wmin, wmax, wint, nouts, boffs,
+                     totbytes, nthreads=None):
+    """
+    Full 12-bit wire preparation of a (D, N) float32 batch: per-stage
+    real-factor downsampling (stage s computes only its true ``nouts[s]``
+    samples), per-(stage, trial) quantisation scale = max|v| / 2047, and
+    2-samples-in-3-bytes packing straight into the (D, totbytes) wire
+    layout with stage s at byte offset ``boffs[s]``.
+
+    Returns (wire (D, totbytes) uint8, scales (S, D) float32).
+    """
+    lib = _require()
+    batch = np.ascontiguousarray(batch, np.float32)
+    D, N = batch.shape
+    S, nout_pad = imin.shape
+    if nthreads is None:
+        nthreads = min(max(os.cpu_count() or 1, 1), 32)
+    out = np.empty((D, int(totbytes)), np.uint8)
+    scales = np.empty((S, D), np.float32)
+    lib.rn_prepare_wire_u12(
+        batch, D, N,
+        np.ascontiguousarray(imin, np.int32),
+        np.ascontiguousarray(imax, np.int32),
+        np.ascontiguousarray(wmin, np.float32),
+        np.ascontiguousarray(wmax, np.float32),
+        np.ascontiguousarray(wint, np.float32),
+        S, nout_pad,
+        np.ascontiguousarray(nouts, np.int32),
+        np.ascontiguousarray(boffs, np.int64),
+        int(totbytes), int(nthreads),
+        scales, out.ctypes.data,
+    )
+    return out, scales
 
 
 def boxcar_snr(data, widths, stdnoise=1.0):

@@ -332,6 +332,121 @@ static void f32_to_f16_vec(const float* in, uint16_t* out, int64_t n) {
     for (int64_t i = 0; i < n; ++i) out[i] = f32_to_f16_rne(in[i]);
 }
 
+// One stage's downsampled values (the real-factor window sums) plus the
+// running max|v|. The float64 operation order matches the scalar path
+// exactly: (w0*x[a] + wi*(c[b]-c[a+1])) + w1*x[b], no FMA contraction,
+// so scalar/AVX2/numpy-fallback all produce identical bytes.
+static void stage_values_scalar(const float* x, const double* c,
+                                const int32_t* a, const int32_t* b,
+                                const float* w0, const float* w1,
+                                const float* wi, float* out, int64_t n,
+                                float* vmax_io) {
+    float vm = *vmax_io;
+    for (int64_t k = 0; k < n; ++k) {
+        const double v = double(w0[k]) * x[a[k]]
+            + double(wi[k]) * (c[b[k]] - c[a[k] + 1])
+            + double(w1[k]) * x[b[k]];
+        const float vf = static_cast<float>(v);
+        out[k] = vf;
+        const float av = std::fabs(vf);
+        if (av > vm) vm = av;
+    }
+    *vmax_io = vm;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("avx2")))
+static void stage_values_avx2(const float* x, const double* c,
+                              const int32_t* a, const int32_t* b,
+                              const float* w0, const float* w1,
+                              const float* wi, float* out, int64_t n,
+                              float* vmax_io) {
+    const __m256 abs_mask =
+        _mm256_castsi256_ps(_mm256_set1_epi32(0x7FFFFFFF));
+    __m256 vmax8 = _mm256_setzero_ps();
+    int64_t k = 0;
+    for (; k + 8 <= n; k += 8) {
+        const __m256i ai =
+            _mm256_loadu_si256(reinterpret_cast<const __m256i*>(a + k));
+        const __m256i bi =
+            _mm256_loadu_si256(reinterpret_cast<const __m256i*>(b + k));
+        const __m256 xa = _mm256_i32gather_ps(x, ai, 4);
+        const __m256 xb = _mm256_i32gather_ps(x, bi, 4);
+        const __m128i alo = _mm256_castsi256_si128(ai);
+        const __m128i ahi = _mm256_extracti128_si256(ai, 1);
+        const __m128i blo = _mm256_castsi256_si128(bi);
+        const __m128i bhi = _mm256_extracti128_si256(bi, 1);
+        const __m256d ca_lo = _mm256_i32gather_pd(c + 1, alo, 8);
+        const __m256d ca_hi = _mm256_i32gather_pd(c + 1, ahi, 8);
+        const __m256d cb_lo = _mm256_i32gather_pd(c, blo, 8);
+        const __m256d cb_hi = _mm256_i32gather_pd(c, bhi, 8);
+        const __m256 w0v = _mm256_loadu_ps(w0 + k);
+        const __m256 w1v = _mm256_loadu_ps(w1 + k);
+        const __m256 wiv = _mm256_loadu_ps(wi + k);
+        const __m256d e0_lo =
+            _mm256_mul_pd(_mm256_cvtps_pd(_mm256_castps256_ps128(w0v)),
+                          _mm256_cvtps_pd(_mm256_castps256_ps128(xa)));
+        const __m256d e0_hi =
+            _mm256_mul_pd(_mm256_cvtps_pd(_mm256_extractf128_ps(w0v, 1)),
+                          _mm256_cvtps_pd(_mm256_extractf128_ps(xa, 1)));
+        const __m256d mid_lo =
+            _mm256_mul_pd(_mm256_cvtps_pd(_mm256_castps256_ps128(wiv)),
+                          _mm256_sub_pd(cb_lo, ca_lo));
+        const __m256d mid_hi =
+            _mm256_mul_pd(_mm256_cvtps_pd(_mm256_extractf128_ps(wiv, 1)),
+                          _mm256_sub_pd(cb_hi, ca_hi));
+        const __m256d e1_lo =
+            _mm256_mul_pd(_mm256_cvtps_pd(_mm256_castps256_ps128(w1v)),
+                          _mm256_cvtps_pd(_mm256_castps256_ps128(xb)));
+        const __m256d e1_hi =
+            _mm256_mul_pd(_mm256_cvtps_pd(_mm256_extractf128_ps(w1v, 1)),
+                          _mm256_cvtps_pd(_mm256_extractf128_ps(xb, 1)));
+        const __m256d v_lo =
+            _mm256_add_pd(_mm256_add_pd(e0_lo, mid_lo), e1_lo);
+        const __m256d v_hi =
+            _mm256_add_pd(_mm256_add_pd(e0_hi, mid_hi), e1_hi);
+        const __m256 v = _mm256_insertf128_ps(
+            _mm256_castps128_ps256(_mm256_cvtpd_ps(v_lo)),
+            _mm256_cvtpd_ps(v_hi), 1);
+        _mm256_storeu_ps(out + k, v);
+        vmax8 = _mm256_max_ps(vmax8, _mm256_and_ps(v, abs_mask));
+    }
+    float tmp[8];
+    _mm256_storeu_ps(tmp, vmax8);
+    float vm = *vmax_io;
+    for (int i = 0; i < 8; ++i) vm = tmp[i] > vm ? tmp[i] : vm;
+    for (; k < n; ++k) {
+        const double v = double(w0[k]) * x[a[k]]
+            + double(wi[k]) * (c[b[k]] - c[a[k] + 1])
+            + double(w1[k]) * x[b[k]];
+        const float vf = static_cast<float>(v);
+        out[k] = vf;
+        if (std::fabs(vf) > vm) vm = std::fabs(vf);
+    }
+    *vmax_io = vm;
+}
+static bool avx2_supported() {
+    static const bool ok = __builtin_cpu_supports("avx2");
+    return ok;
+}
+#else
+static bool avx2_supported() { return false; }
+static void stage_values_avx2(const float*, const double*, const int32_t*,
+                              const int32_t*, const float*, const float*,
+                              const float*, float*, int64_t, float*) {}
+#endif
+
+static void stage_values(const float* x, const double* c, const int32_t* a,
+                         const int32_t* b, const float* w0, const float* w1,
+                         const float* wi, float* out, int64_t n,
+                         float* vmax_io) {
+    if (avx2_supported()) {
+        stage_values_avx2(x, c, a, b, w0, w1, wi, out, n, vmax_io);
+        return;
+    }
+    stage_values_scalar(x, c, a, b, w0, w1, wi, out, n, vmax_io);
+}
+
 void rn_downsample_stages(const float* batch, int64_t D, int64_t N,
                           const int32_t* imin, const int32_t* imax,
                           const float* wmin, const float* wmax,
@@ -391,6 +506,89 @@ void rn_downsample_stages(const float* batch, int64_t D, int64_t N,
                             + double(w1[k]) * x[b[k]];
                         o[k] = static_cast<float>(v);
                     }
+                }
+            }
+        });
+    }
+    for (auto& th : pool) th.join();
+}
+
+// Full wire preparation for the 12-bit packed transport: every cascade
+// stage's real-factor downsampling of a (D, N) batch, quantised to 12
+// bits with a per-(stage, trial) scale = max|v| / 2047 and packed two
+// samples per three bytes DIRECTLY into the caller's (D, totbytes) wire
+// buffer (stage s of trial d at out[d * totbytes + boffs[s]]). Unlike
+// rn_downsample_stages this computes only each stage's true nouts[s]
+// samples (not the plan-wide padded length) and skips the separate
+// copy-into-wire pass, which roughly halves host prep on the survey
+// path. Quantisation bias: q = round(v / scale) + 2048 in [1, 4095];
+// 2048 encodes 0. An odd nouts[s] is padded with one zero sample.
+void rn_prepare_wire_u12(const float* batch, int64_t D, int64_t N,
+                         const int32_t* imin, const int32_t* imax,
+                         const float* wmin, const float* wmax,
+                         const float* wint, int64_t S, int64_t nout_pad,
+                         const int32_t* nouts, const int64_t* boffs,
+                         int64_t totbytes, int64_t nthreads,
+                         float* scales, uint8_t* out) {
+    std::vector<double> cs((N + 1) * D);
+    std::vector<std::thread> pool;
+    if (nthreads <= 0) nthreads = 1;
+    std::atomic<int64_t> next_d(0);
+    for (int64_t t = 0; t < std::min<int64_t>(nthreads, D); ++t) {
+        pool.emplace_back([&]() {
+            int64_t d;
+            while ((d = next_d.fetch_add(1)) < D) {
+                const float* x = batch + d * N;
+                double* c = cs.data() + d * (N + 1);
+                double acc = 0.0;
+                c[0] = 0.0;
+                for (int64_t i = 0; i < N; ++i) { acc += x[i]; c[i + 1] = acc; }
+            }
+        });
+    }
+    for (auto& th : pool) th.join();
+    pool.clear();
+    std::atomic<int64_t> next_job(0);
+    const int64_t njobs = S * D;
+    for (int64_t t = 0; t < std::min<int64_t>(nthreads, njobs); ++t) {
+        pool.emplace_back([&]() {
+            std::vector<float> scratch;
+            int64_t job;
+            while ((job = next_job.fetch_add(1)) < njobs) {
+                const int64_t s = job / D, d = job % D;
+                const float* x = batch + d * N;
+                const double* c = cs.data() + d * (N + 1);
+                const int32_t* a = imin + s * nout_pad;
+                const int32_t* b = imax + s * nout_pad;
+                const float* w0 = wmin + s * nout_pad;
+                const float* w1 = wmax + s * nout_pad;
+                const float* wi = wint + s * nout_pad;
+                const int64_t n = nouts[s];
+                scratch.resize(n + 1);
+                float vmax = 0.0f;
+                stage_values(x, c, a, b, w0, w1, wi, scratch.data(), n,
+                             &vmax);
+                scratch[n] = 0.0f;  // pad sample for odd n
+                const float scale = vmax > 0.0f ? vmax / 2047.0f : 1.0f;
+                scales[s * D + d] = scale;
+                const float inv = 1.0f / scale;
+                uint8_t* o = out + d * totbytes + boffs[s];
+                const int64_t pairs = (n + 1) / 2;
+                // Round-half-even via the 1.5*2^23 magic constant
+                // (exact for |v| <= 2^22, and |v*inv| <= 2047 here):
+                // unlike lrintf this auto-vectorizes.
+                const float magic = 12582912.0f;  // 1.5 * 2^23
+                for (int64_t k = 0; k < pairs; ++k) {
+                    union { float f; int32_t i; } u0, u1;
+                    u0.f = scratch[2 * k] * inv + magic;
+                    u1.f = scratch[2 * k + 1] * inv + magic;
+                    // mantissa = round(v) + 2^22 for v in [-2^22, 2^22)
+                    const int32_t q0 = (u0.i & 0x7FFFFF) - 4194304 + 2048;
+                    const int32_t q1 = (u1.i & 0x7FFFFF) - 4194304 + 2048;
+                    o[3 * k] = static_cast<uint8_t>(q0 & 255);
+                    o[3 * k + 1] = static_cast<uint8_t>(
+                        ((q0 >> 8) & 15) | ((q1 & 15) << 4));
+                    o[3 * k + 2] = static_cast<uint8_t>((q1 >> 4) & 255);
                 }
             }
         });

@@ -28,6 +28,12 @@ Output:
         single-trial forms without the D axis.)
 """
 import functools
+import hashlib
+import logging
+import os
+import pickle
+import tempfile
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -35,12 +41,62 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+log = logging.getLogger("riptide_tpu.ffa_kernel")
+
 from .slottables import (A_SHIFT, A_BITS, B_SHIFT, B_BITS, NAT_LEVELS,
                          PH_BITS, PH_MASK, build_tables)
 
-__all__ = ["ffa_snr_cycle", "NWPAD"]
+__all__ = ["ffa_snr_cycle", "NWPAD", "VMEM_LIMIT", "kernel_vmem_bytes"]
 
 NWPAD = 16  # coef slots reserved per coefficient bank
+
+# Scoped-VMEM budget shared by the kernel's CompilerParams and the
+# engine's stage-eligibility check (search/engine.py:_kernel_eligible):
+# deriving both from this one place means a change to the kernel's
+# temporary count cannot silently break one of them. v5e has 128 MiB of
+# VMEM per core.
+VMEM_LIMIT = 100 * 1024 * 1024
+# Live (rows, P) float32 temporaries of the unrolled select chains, by
+# inspection of the deepest level's dataflow (head/tail chains + barrel)
+# plus the A/B ping-pong scratch, with slack for Mosaic's own spills.
+N_LIVE_BUFS = 10
+
+
+def num_level_tables(L, NL):
+    """Packed level-word tables per problem: NL natural + (L - NL)
+    spread + (L - NL) slot."""
+    return NL + 2 * (L - NL)
+
+
+def kernel_vmem_bytes(L, NL, rows, P, resident_tables):
+    """Worst-case scoped-VMEM bytes of one kernel program.
+
+    ``resident_tables=True`` accounts for the persistent all-levels
+    table scratch used when the grid iterates DM trials innermost;
+    ``False`` is the streaming fallback (one level table at a time).
+    """
+    bufs = N_LIVE_BUFS * rows * P * 4
+    ntab = num_level_tables(L, NL) if resident_tables else 1
+    return bufs + ntab * rows * 128 * 4
+
+
+# Resident table scratches beyond this size reproducibly OOM-kill the
+# Mosaic compiler service on the deep (L=11, rows 2048, ~20 MB) bucket;
+# the largest observed-good scratch is the L=10 bucket's ~8.9 MB.
+RESIDENT_TABLE_CAP = 12 * 1024 * 1024
+
+
+def tables_resident(L, NL, rows, P):
+    """Whether the per-bins-trial all-levels table scratch is used:
+    it must fit the VMEM budget AND stay under the compiler-friendly
+    size cap (larger scratches crash the Mosaic compiler — deeper
+    buckets stream tables level-by-level as before).
+    RIPTIDE_KERNEL_RESIDENT=0 forces streaming everywhere."""
+    if os.environ.get("RIPTIDE_KERNEL_RESIDENT") == "0":
+        return False
+    tab_bytes = num_level_tables(L, NL) * rows * 128 * 4
+    return (tab_bytes <= RESIDENT_TABLE_CAP
+            and kernel_vmem_bytes(L, NL, rows, P, True) < VMEM_LIMIT)
 
 
 def _roll_r(x, c, rows):
@@ -56,27 +112,45 @@ def _lane_up(x, c, P):
 
 
 def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
-            *, L, NL, rows, P, RS, widths, nspread, pbits):
-    d = pl.program_id(0)  # DM-trial index (tables are shared across it)
-    b = pl.program_id(1)  # bins-trial index
+            *, L, NL, rows, P, RS, widths, nspread, pbits, resident):
+    # Grid is (B, D) with the DM trial d innermost, so the D consecutive
+    # programs of one bins-trial b share tables: with ``resident`` the
+    # whole level-table set is DMA'd into a persistent VMEM scratch once
+    # per b (at d == 0) instead of level-by-level in every program —
+    # through a (D, B) grid the tables were re-fetched D times each.
+    b = pl.program_id(0)  # bins-trial index
+    d = pl.program_id(1)  # DM-trial index (tables are shared across it)
     p = scal[b, 0]
 
     cp = pltpu.make_async_copy(x_hbm.at[d, b], A, semx)
     cp.start()
+
+    if resident:
+        @pl.when(d == 0)
+        def _load_tables():
+            cpt = pltpu.make_async_copy(tab_hbm.at[b], T, semt)
+            cpt.start()
+            cpt.wait()
+
+        def load_tab(lev):
+            tv = T[lev]
+            return tv if P == 128 else pltpu.repeat(tv, P // 128, axis=1)
+
+    else:
+        def load_tab(lev):
+            cpt = pltpu.make_async_copy(tab_hbm.at[b, lev], T, semt)
+            cpt.start()
+            cpt.wait()
+            # The words are lane-replicated in HBM; widen 128 -> P lanes
+            # with a tiled repeat (a width-1 lane slice + broadcast
+            # SIGABRTs the Mosaic compiler at rows >= 8 sublane tiles).
+            tv = T[:]
+            return tv if P == 128 else pltpu.repeat(tv, P // 128, axis=1)
+
     cp.wait()
 
     cols = jax.lax.broadcasted_iota(jnp.int32, (rows, P), 1)
     colmask = cols < p
-
-    def load_tab(lev):
-        cpt = pltpu.make_async_copy(tab_hbm.at[b, lev], T, semt)
-        cpt.start()
-        cpt.wait()
-        # The words are lane-replicated in HBM; widen 128 -> P lanes with
-        # a tiled repeat (a width-1 lane slice + broadcast SIGABRTs the
-        # Mosaic compiler at rows >= 8 sublane tiles).
-        tv = T[:]
-        return tv if P == 128 else pltpu.repeat(tv, P // 128, axis=1)
 
     def tail_wrap(tail, sig, thr, nbits):
         for k in range(nbits):
@@ -220,28 +294,133 @@ def _pack_coef(ps, widths, hcoef, bcoef, stdnoise):
     return coef
 
 
+# ---------------------------------------------------------------------------
+# Persistent executable cache.
+#
+# Mosaic/Pallas executables are NOT stored in JAX's persistent
+# compilation cache (only plain XLA programs are), so every fresh
+# process pays the full multi-minute kernel compile. The compiled
+# executable, however, serializes and reloads across processes in ~0.1 s
+# (jax.experimental.serialize_executable), which is what turns a cold
+# ~10-minute survey warmup into seconds on a warm cache. Keyed by the
+# kernel source file, jax version, device kind and the full build key;
+# any failure falls back to the ordinary jit path.
+# ---------------------------------------------------------------------------
+
+# Per-user cache directory (0700): the entries are pickles, so the
+# directory must not be spoofable/writable by other local users.
+_EXEC_DIR = os.environ.get(
+    "RIPTIDE_KERNEL_CACHE",
+    os.path.join(tempfile.gettempdir(),
+                 f"riptide_tpu_kernel_cache_{os.getuid()}"),
+)
+
+
+def _exec_cache_path(key):
+    h = hashlib.sha1()
+    # The executable depends on this file AND the packed-word format /
+    # table layout of slottables.py — hash both so an edit to either
+    # invalidates every cached kernel.
+    for mod in (__file__,
+                os.path.join(os.path.dirname(__file__), "slottables.py")):
+        with open(mod, "rb") as f:
+            h.update(f.read())
+    h.update(jax.__version__.encode())
+    dev = jax.devices()[0]
+    h.update(f"{dev.platform}:{getattr(dev, 'device_kind', '')}".encode())
+    h.update(repr(key).encode())
+    return os.path.join(_EXEC_DIR, h.hexdigest() + ".pkl")
+
+
+class _CachedCall:
+    """Lazily compiled pallas call with a cross-process executable cache
+    (TPU backends only; CPU/interpret use the plain jit path)."""
+
+    def __init__(self, key, jitted, arg_shapes):
+        self.key = key
+        self.jitted = jitted
+        self.arg_shapes = arg_shapes
+        self._fn = None
+        self._lock = threading.Lock()
+
+    def _aot_args(self):
+        return [jax.ShapeDtypeStruct(s, d) for s, d in self.arg_shapes]
+
+    def warm(self):
+        """Compile (or load) the executable without running it."""
+        with self._lock:
+            if self._fn is not None:
+                return
+            try:
+                tpu = jax.default_backend() in ("tpu", "axon")
+            except RuntimeError:
+                tpu = False
+            if not tpu or os.environ.get("RIPTIDE_KERNEL_CACHE") == "off":
+                self._fn = self.jitted
+                return
+            from jax.experimental import serialize_executable as se
+
+            path = _exec_cache_path(self.key)
+            if os.path.exists(path):
+                try:
+                    with open(path, "rb") as f:
+                        payload, in_tree, out_tree = pickle.load(f)
+                    self._fn = se.deserialize_and_load(
+                        payload, in_tree, out_tree)
+                    log.debug("kernel executable loaded from %s", path)
+                    return
+                except Exception as err:
+                    log.warning("kernel cache load failed (%s); recompiling",
+                                err)
+            try:
+                compiled = self.jitted.lower(*self._aot_args()).compile()
+            except Exception as err:
+                log.warning("AOT kernel compile failed (%s); "
+                            "falling back to jit", err)
+                self._fn = self.jitted
+                return
+            try:
+                os.makedirs(_EXEC_DIR, mode=0o700, exist_ok=True)
+                payload = se.serialize(compiled)
+                fd, tmp = tempfile.mkstemp(dir=_EXEC_DIR, suffix=".tmp")
+                with os.fdopen(fd, "wb") as f:
+                    pickle.dump(payload, f)
+                os.replace(tmp, path)
+            except Exception as err:
+                log.warning("kernel cache store failed (%s)", err)
+            self._fn = compiled
+
+    def __call__(self, *args):
+        if self._fn is None:
+            self.warm()
+        return self._fn(*args)
+
+
 @functools.lru_cache(maxsize=64)
 def _build_call(L, NL, rows, P, RS, widths, nspread, pbits, D, B, interpret):
+    resident = tables_resident(L, NL, rows, P)
     kern = functools.partial(
         _kernel, L=L, NL=NL, rows=rows, P=P, RS=RS,
-        widths=widths, nspread=nspread, pbits=pbits,
+        widths=widths, nspread=nspread, pbits=pbits, resident=resident,
     )
+    ntab = num_level_tables(L, NL)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
-        grid=(D, B),
+        grid=(B, D),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, RS, 128), lambda d, b: (d, b, 0, 0), memory_space=pltpu.VMEM
+            (1, 1, RS, 128), lambda b, d: (d, b, 0, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
             pltpu.VMEM((rows, P), jnp.float32),
             pltpu.VMEM((rows, P), jnp.float32),
-            pltpu.VMEM((rows, 128), jnp.int32),
+            pltpu.VMEM((ntab, rows, 128) if resident else (rows, 128),
+                       jnp.int32),
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
@@ -252,11 +431,22 @@ def _build_call(L, NL, rows, P, RS, widths, nspread, pbits, D, B, interpret):
         out_shape=jax.ShapeDtypeStruct((D, B, RS, 128), jnp.float32),
         # The unrolled select chains keep ~8 (rows, P) f32 temporaries
         # live; at the deepest bucket (2048, 384) that exceeds the 16M
-        # default scoped-vmem limit. v5e has 128M VMEM per core.
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024),
+        # default scoped-vmem limit (budget shared with the engine's
+        # eligibility check via kernel_vmem_bytes).
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT),
         interpret=bool(interpret),
     )
-    return jax.jit(call)
+    jitted = jax.jit(call)
+    if interpret:
+        return jitted
+    key = (L, NL, rows, P, RS, widths, nspread, pbits, D, B, resident)
+    arg_shapes = (
+        ((B, 32), jnp.int32),
+        ((B, 32), jnp.float32),
+        ((D, B, rows, P), jnp.float32),
+        ((B, ntab, rows, 128), jnp.int32),
+    )
+    return _CachedCall(key, jitted, arg_shapes)
 
 
 class CycleKernel:
@@ -341,19 +531,23 @@ class CycleKernel:
             )
         return self._dev
 
+    def build(self, D=1):
+        """The (possibly disk-cached) compiled call for a DM-batch of
+        ``D``; see :class:`_CachedCall`."""
+        return _build_call(self.L, self.NL, self.rows, self.P, self.RS,
+                           self.widths, self.nspread, self.pbits,
+                           D, self.B, self.interpret)
+
     def __call__(self, x):
         """x: (B, rows, P) or (D, B, rows, P) f32 natural-packed
         container(s). Returns (B, RS, 128) / (D, B, RS, 128) f32 S/N.
         Tables/coefficients are shared across the leading DM axis; the
-        grid is (D, B) so nothing is replicated per DM trial."""
+        grid is (B, D) so nothing is replicated per DM trial."""
         scal, coef, wrep = self._operands()
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
-        call = _build_call(self.L, self.NL, self.rows, self.P, self.RS,
-                           self.widths, self.nspread, self.pbits,
-                           x.shape[0], self.B, self.interpret)
-        out = call(scal, coef, x, wrep)
+        out = self.build(x.shape[0])(scal, coef, x, wrep)
         return out[0] if squeeze else out
 
 

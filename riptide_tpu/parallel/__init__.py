@@ -16,12 +16,14 @@ For transforms too large for one chip's HBM, sequence parallelism shards
 the fold container's row axis instead (:mod:`riptide_tpu.parallel.seqffa`).
 
 Multi-host: :func:`init_distributed` wraps ``jax.distributed.initialize``;
-all collectives ride XLA over ICI/DCN.
+:func:`run_search_multihost` searches one DM shard per process and
+all-gathers the Peak lists; all collectives ride XLA over ICI/DCN.
 """
 from .mesh import default_mesh, mesh_2d
 from .sharded import run_periodogram_sharded, run_search_sharded
 from .seqffa import ffa2_seq, seq_mesh
 from .distributed import init_distributed
+from .multihost import gather_peaks, run_search_multihost
 
 __all__ = [
     "default_mesh",
@@ -31,4 +33,6 @@ __all__ = [
     "ffa2_seq",
     "seq_mesh",
     "init_distributed",
+    "gather_peaks",
+    "run_search_multihost",
 ]

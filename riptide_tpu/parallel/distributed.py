@@ -28,8 +28,11 @@ def init_distributed(coordinator_address=None, num_processes=None, process_id=No
     cluster auto-detection (``jax.distributed.initialize`` semantics).
     Returns True if a multi-process runtime was initialised.
     """
-    if jax.process_count() > 1:
-        return True  # already initialised
+    # NB: probing via jax.process_count() would itself initialise the
+    # XLA backend, after which jax.distributed.initialize refuses to
+    # run; use the side-effect-free is_initialized().
+    if jax.distributed.is_initialized():
+        return jax.process_count() > 1
     explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if explicit is None and num_processes is None:
         return False

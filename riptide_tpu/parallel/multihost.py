@@ -1,0 +1,92 @@
+"""
+Multi-host (multi-process) survey execution.
+
+DM trials are embarrassingly parallel, so the multi-host layout is one
+DM shard per process: each host searches its local (D_local, N) batch on
+its own devices through the fast unsharded engine path, and only the
+resulting Peak lists — KB-scale, the same tiny-results contract as the
+reference's worker pool (riptide/pipeline/worker_pool.py:47-71) — cross
+process boundaries, via one pair of all-gathers over the
+``jax.distributed`` runtime (riptide_tpu.parallel.distributed).
+
+This is the TPU-native counterpart of the reference's tested
+``processes: 2`` parallel pipeline mode
+(riptide/tests/test_pipeline.py:14-31): where the reference forks local
+worker processes, a multi-host JAX deployment runs one process per host
+with the coordinator wiring of ``init_distributed``; the exchange rides
+the distributed runtime's CPU collectives (DCN across hosts).
+"""
+import numpy as np
+
+import jax
+
+from ..peak_detection import Peak
+
+__all__ = ["gather_peaks", "run_search_multihost"]
+
+# Peak is a flat record of 8 numeric fields; encode/decode as float64.
+_FIELDS = ("period", "freq", "width", "ducy", "iw", "ip", "snr", "dm")
+_INT_FIELDS = {"width", "iw", "ip"}
+
+
+def _encode(peaks):
+    arr = np.zeros((len(peaks), len(_FIELDS)), np.float64)
+    for i, p in enumerate(peaks):
+        arr[i] = [float(getattr(p, f)) for f in _FIELDS]
+    return arr
+
+
+def _decode(arr):
+    out = []
+    for row in arr:
+        kw = {
+            f: (int(v) if f in _INT_FIELDS else float(v))
+            for f, v in zip(_FIELDS, row)
+        }
+        out.append(Peak(**kw))
+    return out
+
+
+def gather_peaks(local_peaks):
+    """All-gather Peak lists across every process of the distributed
+    runtime; every process returns the identical concatenated list
+    (process order, then local order). Single-process: a plain copy."""
+    local_peaks = list(local_peaks)
+    if jax.process_count() == 1:
+        return local_peaks
+    from jax.experimental import multihost_utils
+
+    arr = _encode(local_peaks)
+    counts = multihost_utils.process_allgather(
+        np.asarray([arr.shape[0]], np.int64)
+    ).reshape(-1)
+    mx = max(int(counts.max()), 1)
+    padded = np.zeros((mx, len(_FIELDS)), np.float64)
+    padded[: arr.shape[0]] = arr
+    gathered = multihost_utils.process_allgather(padded)
+    out = []
+    for cnt, block in zip(counts, gathered):
+        out.extend(_decode(block[: int(cnt)]))
+    return out
+
+
+def run_search_multihost(plan, batch_local, tobs, dms_local=None,
+                         **peak_kwargs):
+    """
+    Search this process's local DM-trial batch and exchange results:
+    returns (peaks, polycos_local) where ``peaks`` is the SAME global
+    flat Peak list on every process (sorted by decreasing S/N) and
+    ``polycos_local`` are this process's per-trial threshold
+    polynomials.
+    """
+    from ..search.engine import run_search_batch
+
+    D = np.asarray(batch_local).shape[0]
+    if dms_local is None:
+        dms_local = np.zeros(D)
+    peaks_per_trial, polycos = run_search_batch(
+        plan, batch_local, tobs=tobs, dms=dms_local, **peak_kwargs
+    )
+    flat = [p for trial in peaks_per_trial for p in trial]
+    peaks = sorted(gather_peaks(flat), key=lambda p: p.snr, reverse=True)
+    return peaks, polycos

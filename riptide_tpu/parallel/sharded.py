@@ -127,9 +127,17 @@ def _queue_stages_sharded(plan, batch, mesh):
                 f"the plan's padded bins-trial count {B}"
             )
 
-    from ..search.engine import prepare_stage_data
+    from ..search.engine import _ffa_path, _wire_mode, prepare_stage_data
 
-    flat, path = prepare_stage_data(plan, batch)
+    # The sharded wire stays in a float dtype (element-addressed slices
+    # below); the 12-bit byte-packed transport is wired through the
+    # unsharded survey path only. An explicit RIPTIDE_WIRE_DTYPE float
+    # override is still honored.
+    wire = _wire_mode(_ffa_path())
+    if wire == "uint12":
+        wire = "float16" if _ffa_path() == "kernel" else "float32"
+    flat, meta = prepare_stage_data(plan, batch, mode=wire)
+    path = meta["path"]
     flat_dev = jnp.asarray(flat)  # ONE host->device transfer
     outs = []
     off = 0

@@ -29,7 +29,7 @@ import numpy as np
 
 from ..ffautils import generate_width_trials
 from ..search import periodogram_plan
-from ..search.engine import run_search_batch
+from ..search.engine import collect_search_batch, queue_search_batch
 from ..time_series import TimeSeries
 
 log = logging.getLogger("riptide_tpu.pipeline.batcher")
@@ -105,15 +105,24 @@ class BatchSearcher:
                 return self._prepare_chunk(tslist)
 
             pending = stager.submit(stage_chunk, chunks[0]) if chunks else None
+            queued = None
             for i, chunk in enumerate(chunks):
                 items = pending.result()
                 if i + 1 < len(chunks):
                     pending = stager.submit(stage_chunk, chunks[i + 1])
-                peaks.extend(self._execute_chunk(items))
+                # Queue chunk i's device work BEFORE collecting chunk
+                # i-1: the device stays busy while the host pays the
+                # previous chunk's result round trip.
+                nxt = self._queue_chunk(items)
+                if queued is not None:
+                    peaks.extend(self._collect_chunk(queued))
+                queued = nxt
                 log.debug(
-                    f"Chunk {i + 1}/{len(chunks)} ({len(chunk)} files) done, "
-                    f"total peaks: {len(peaks)}"
+                    f"Chunk {i + 1}/{len(chunks)} ({len(chunk)} files) "
+                    f"queued, total peaks: {len(peaks)}"
                 )
+            if queued is not None:
+                peaks.extend(self._collect_chunk(queued))
         return peaks
 
     def process_fname_list(self, fnames):
@@ -163,29 +172,42 @@ class BatchSearcher:
                 items.append((members, batch, conf, plan, prepared))
         return items
 
-    def _execute_chunk(self, items):
-        allpeaks = []
-        for members, batch, conf, plan, prepared in items:
-            allpeaks.extend(
-                self._search_range(conf, members, batch, plan, prepared)
-            )
-        return allpeaks
+    def _queue_chunk(self, items):
+        return [
+            self._queue_range(conf, members, batch, plan, prepared)
+            for members, batch, conf, plan, prepared in items
+        ]
 
-    def _search_range(self, conf, members, batch, plan, prepared=None):
+    def _collect_chunk(self, queued):
+        return [p for collect in queued for p in collect()]
+
+    def _queue_range(self, conf, members, batch, plan, prepared=None):
+        """Enqueue one (search range x chunk) device program; returns a
+        zero-argument collector producing the chunk's Peak list."""
         dms = [float(ts.metadata["dm"] or 0.0) for ts in members]
         dms += [0.0] * (batch.shape[0] - len(members))
         tobs = batch.shape[1] * members[0].tsamp
         fp_kwargs = conf.get("find_peaks", {})
+        nreal = len(members)
         if self.mesh is not None:
             from ..parallel import run_search_sharded
 
+            # The sharded path syncs internally (shard_map outputs are
+            # gathered per call); run it eagerly.
             peaks_per_trial, _ = run_search_sharded(
                 plan, batch, tobs=tobs, dms=dms, mesh=self.mesh, **fp_kwargs
             )
-        else:
-            peaks_per_trial, _ = run_search_batch(
-                plan, batch, tobs=tobs, dms=dms, prepared=prepared,
-                **fp_kwargs
-            )
-        # Padded trials (zero data) produce no peaks; slice to real ones.
-        return [p for d in range(len(members)) for p in peaks_per_trial[d]]
+            return lambda: [
+                p for d in range(nreal) for p in peaks_per_trial[d]
+            ]
+        handle = queue_search_batch(
+            plan, batch, tobs=tobs, prepared=prepared, **fp_kwargs
+        )
+
+        def collect():
+            peaks_per_trial, _ = collect_search_batch(handle, dms)
+            # Padded trials (zero data) produce no peaks; slice to real
+            # ones.
+            return [p for d in range(nreal) for p in peaks_per_trial[d]]
+
+        return collect

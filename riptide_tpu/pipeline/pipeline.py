@@ -60,6 +60,39 @@ class CandidateWriter:
             cand.savefig(fname)
 
 
+def render_spawned(writer, arglist, processes):
+    """Render candidate JSON+PNGs concurrently in spawned CPU-only
+    worker processes (the parallel-plotting counterpart of the
+    reference's fork pool, riptide/pipeline/pipeline.py:370-379). The
+    environment is patched for the duration of the pool — spawned
+    interpreters read it at startup, so they come up as plain CPU
+    processes that cannot claim an accelerator; any failure falls back
+    to sequential rendering."""
+    import multiprocessing as mp
+    from concurrent.futures import ProcessPoolExecutor
+
+    patched = {"JAX_PLATFORMS": "cpu", "MPLBACKEND": "Agg",
+               "PYTHONPATH": ""}
+    saved = {k: os.environ.get(k) for k in patched}
+    os.environ.update(patched)
+    try:
+        with ProcessPoolExecutor(
+            max_workers=int(processes), mp_context=mp.get_context("spawn"),
+        ) as ex:
+            list(ex.map(writer, arglist, chunksize=4))
+    except Exception as err:  # pragma: no cover - defensive
+        log.warning(f"spawned plot rendering failed ({err}); "
+                    "rendering sequentially")
+        for arg in arglist:
+            writer(arg)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 class Pipeline:
     """
     Top-level multi-DM-trial search.
@@ -117,6 +150,14 @@ class Pipeline:
         for rg in ranges:
             if rg["ffa_search"]["period_min"] <= period < rg["ffa_search"]["period_max"]:
                 return dict(rg)
+        # Non-contiguous ranges (possible when a Pipeline is built from a
+        # raw config dict — YAML configs are contiguity-checked) can leave
+        # a period in a gap; fail loudly rather than returning None into
+        # candidate building.
+        raise ValueError(
+            f"period={period:.9f} s falls in a gap between non-contiguous "
+            f"search ranges; no range covers it"
+        )
 
     # -- stages -------------------------------------------------------------
 
@@ -320,13 +361,18 @@ class Pipeline:
         arglist = list(enumerate(self.candidates))
         # JSON writing parallelises over host threads (I/O bound). PNG
         # rendering goes through matplotlib's non-thread-safe state, so
-        # plots are rendered sequentially. fork()-based process pools are
-        # off the table here: by this point the JAX/XLA runtime holds
-        # locks that a forked child would snapshot mid-held and deadlock
-        # on, and a spawned child would re-claim the TPU runtime.
+        # plots render in a SPAWN-based process pool (the reference uses
+        # a fork pool, riptide/pipeline/pipeline.py:370-379; fork is off
+        # the table here — by this point the JAX/XLA runtime holds locks
+        # a forked child would snapshot mid-held). Spawned children are
+        # kept plain CPU interpreters: JAX_PLATFORMS=cpu, MPLBACKEND=Agg
+        # and a PYTHONPATH stripped of any site customization that would
+        # claim an accelerator at interpreter start.
         if not self.config["plot_candidates"]:
             with ThreadPoolExecutor(max_workers=self.config["processes"]) as ex:
                 list(ex.map(writer, arglist))
+        elif self.config["processes"] > 1 and len(arglist) > 2:
+            render_spawned(writer, arglist, self.config["processes"])
         else:
             for arg in arglist:
                 writer(arg)

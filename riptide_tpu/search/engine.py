@@ -36,7 +36,7 @@ from ..ops.ffa_kernel import NWPAD
 from ..ops.snr import snr_batched
 
 __all__ = ["run_periodogram", "run_periodogram_batch", "run_search_batch",
-           "cycle_fn"]
+           "queue_search_batch", "collect_search_batch", "cycle_fn"]
 
 
 def _pack(xd, p, m, R, P):
@@ -183,17 +183,111 @@ def _pack_static(flat, off, n, shapes, rows, P):
     return jnp.stack(outs, axis=-3)
 
 
-def _wire_dtype(path):
-    """Host->device wire dtype for downsampled stage data. float16 by
-    default on the kernel path: the values are normalised (unit-variance
-    noise x sqrt(factor)), so the 11-bit mantissa costs ~5e-4 relative
-    per sample — an S/N error ~EPS*S/N ~ 0.01 at the parity bar of
-    18.5 +/- 0.15 — while halving the dominant transfer. Override with
-    RIPTIDE_WIRE_DTYPE=float32|float16."""
+def _wire_mode(path):
+    """Host->device wire transport for downsampled stage data.
+
+    'uint12' (default on the kernel path): 12-bit quantisation, two
+    samples in three bytes, per-(stage, trial) scale = max|v| / 2047.
+    Quantisation error is <= max/4094 per sample — an S/N error of the
+    same ~0.01 order as the float16 wire's (both enforced against the
+    18.5 +/- 0.15 oracle by tests) — at 75% of float16's bytes; through
+    a ~50 MB/s tunneled device the wire is the survey throughput
+    ceiling, so bytes are the metric that matters. 'float16' costs
+    ~5e-4 relative per sample; 'float32' is exact (gather-path
+    default). Override with RIPTIDE_WIRE_DTYPE=float32|float16|uint12.
+    """
     mode = os.environ.get("RIPTIDE_WIRE_DTYPE")
     if mode:
-        return np.dtype(mode)
-    return np.dtype(np.float16 if path == "kernel" else np.float32)
+        mode = {"u12": "uint12"}.get(mode, mode)
+        if mode not in ("float32", "float16", "uint12"):
+            raise ValueError(f"unsupported RIPTIDE_WIRE_DTYPE={mode!r}")
+        return mode
+    return "uint12" if path == "kernel" else "float32"
+
+
+def _wire_layout(plan, mode):
+    """Per-stage (offsets, lengths, total) of the flat wire buffer, in
+    the mode's storage unit: BYTES for 'uint12' (each stage 3 bytes per
+    sample pair, odd sample counts padded by one), ELEMENTS otherwise."""
+    if mode == "uint12":
+        lens = [3 * ((st.n + 1) // 2) for st in plan.stages]
+    else:
+        lens = [st.n for st in plan.stages]
+    offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+    return offs[:-1], lens, int(offs[-1])
+
+
+def _u12_decode(seg, scale):
+    """(..., nb) uint8 wire bytes -> (..., 2 * nb // 3) float32 samples.
+    Inverse of the packing in native rn_prepare_wire_u12."""
+    lead = seg.shape[:-1]
+    nb = seg.shape[-1]
+    trip = seg.reshape(lead + (nb // 3, 3)).astype(jnp.int32)
+    b0, b1, b2 = trip[..., 0], trip[..., 1], trip[..., 2]
+    q = jnp.stack([b0 | ((b1 & 15) << 8), (b1 >> 4) | (b2 << 4)], axis=-1)
+    q = q.reshape(lead + (2 * (nb // 3),))
+    return (q.astype(jnp.float32) - 2048.0) * scale[..., None]
+
+
+@partial(jax.jit, static_argnames=("off", "nb", "n", "shapes", "rows", "P"))
+def _pack_static_u12(flat, scale, off, nb, n, shapes, rows, P):
+    """uint12 counterpart of :func:`_pack_static`: slice nb wire bytes,
+    decode to float32 with the stage's per-trial scales, then the same
+    per-problem reshape + zero-pad. One dispatch per stage."""
+    seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
+    xd = _u12_decode(seg, scale)[..., :n]
+    outs = []
+    for m, p in shapes:
+        sub = xd[..., : m * p].reshape(xd.shape[:-1] + (m, p))
+        pad = [(0, 0)] * (sub.ndim - 2) + [(0, rows - m), (0, P - p)]
+        outs.append(jnp.pad(sub, pad))
+    return jnp.stack(outs, axis=-3)
+
+
+@partial(jax.jit, static_argnames=("off", "nb", "n", "nout"))
+def _unpack_u12_padded(flat, scale, off, nb, n, nout):
+    """Gather-path uint12 unpack: decode one stage's samples and
+    zero-pad to the plan-wide padded length."""
+    seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
+    xd = _u12_decode(seg, scale)[..., :n]
+    return jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
+
+
+def _prepare_u12(plan, batch):
+    """12-bit wire preparation: native single-pass when available,
+    vectorised numpy otherwise. Returns (wire (D, totbytes) uint8,
+    scales (S, D) float32)."""
+    from .. import native
+
+    offs, lens, tot = _wire_layout(plan, "uint12")
+    if native.available():
+        imin, imax, wmin, wmax, wint = _ds_pack(plan)
+        nouts = np.asarray([st.n for st in plan.stages], np.int32)
+        return native.prepare_wire_u12(
+            batch, imin, imax, wmin, wmax, wint, nouts, offs, tot
+        )
+    d64, cs = _prefix64(batch)
+    D = batch.shape[0]
+    out = np.zeros((D, tot), np.uint8)
+    scales = np.empty((len(plan.stages), D), np.float32)
+    for i, st in enumerate(plan.stages):
+        xd = _stage_downsample(st, d64, cs)[..., : st.n]
+        vmax = np.abs(xd).max(axis=1)
+        s = np.where(vmax > 0, vmax / 2047.0, 1.0).astype(np.float32)
+        scales[i] = s
+        # Multiply by the float32 reciprocal exactly like the native
+        # path (rn_prepare_wire_u12) so both produce identical bytes.
+        inv = (np.float32(1.0) / s).astype(np.float32)
+        q = np.rint(xd * inv[:, None]).astype(np.int32) + 2048
+        if st.n % 2:
+            q = np.concatenate([q, np.full((D, 1), 2048, np.int32)], axis=1)
+        q0, q1 = q[:, 0::2], q[:, 1::2]
+        tmp = np.empty((D, q0.shape[1], 3), np.uint8)
+        tmp[..., 0] = q0 & 255
+        tmp[..., 1] = ((q0 >> 8) & 15) | ((q1 & 15) << 4)
+        tmp[..., 2] = (q1 >> 4) & 255
+        out[:, offs[i] : offs[i] + lens[i]] = tmp.reshape(D, lens[i])
+    return out, scales
 
 
 @partial(jax.jit, static_argnames=("widths", "P"))
@@ -227,22 +321,27 @@ def _ffa_path():
 def _kernel_eligible(st, plan):
     """The fused Pallas kernel serves a stage when its packed-word layout
     fits (p <= PH_MASK = 2047), the width ladder fits the coefficient
-    bank, the container is at least one sublane tile, and the working
-    set (~10 (rows, P) f32 buffers of unrolled temporaries) fits VMEM.
-    Ineligible stages fall back to the gather path per stage."""
-    from ..ops.ffa_kernel import PH_MASK
+    bank, the container is at least one sublane tile, and the streaming
+    working set fits the kernel's own VMEM budget (the same
+    ``kernel_vmem_bytes`` the kernel's CompilerParams limit derives
+    from, so the two cannot drift apart). Ineligible stages fall back to
+    the gather path per stage."""
+    from ..ops.ffa_kernel import PH_MASK, VMEM_LIMIT, kernel_vmem_bytes
+    from ..ops.slottables import NAT_LEVELS
 
-    rows = 1 << st.kernel_depth
+    L = st.kernel_depth
+    NL = min(L, NAT_LEVELS)
+    rows = 1 << L
     P = -(-max(st.ps_padded) // 128) * 128
     return (
         st.kernel_depth >= 3
         and max(st.ps_padded) <= PH_MASK
         and len(plan.widths) <= NWPAD
-        and rows * P * 4 * 10 < 100 * 1024 * 1024
+        and kernel_vmem_bytes(L, NL, rows, P, False) < VMEM_LIMIT
     )
 
 
-def _run_stage_kernel(st, flat_dev, off, plan):
+def _run_stage_kernel(st, flat_dev, off, plan, meta, i):
     """Queue one kernel-path cascade stage from the shipped wire buffer;
     returns the (..., B, rows_eval_max, NW) S/N container unsynced. The
     raw (B, RS, 128) kernel output is sliced immediately so it can be
@@ -250,9 +349,13 @@ def _run_stage_kernel(st, flat_dev, off, plan):
     costs ~170 MB x stages of HBM and OOMs large DM batches."""
     interpret = jax.default_backend() == "cpu"
     kern = st.cycle_kernel(interpret=interpret)
-    x = _pack_static(flat_dev, off, st.n,
-                     tuple(zip(st.ms_padded, st.ps_padded)),
-                     kern.rows, kern.P)
+    shapes = tuple(zip(st.ms_padded, st.ps_padded))
+    if meta["mode"] == "uint12":
+        x = _pack_static_u12(flat_dev, meta["scales_dev"][i], off,
+                             meta["lens"][i], st.n, shapes,
+                             kern.rows, kern.P)
+    else:
+        x = _pack_static(flat_dev, off, st.n, shapes, kern.rows, kern.P)
     out = kern(x)
     return out[..., : max(st.rows_eval_max, 1), : len(plan.widths)]
 
@@ -324,31 +427,39 @@ def _assemble_device(plan, *outs):
     return jnp.concatenate(chunks, axis=1)
 
 
-def prepare_stage_data(plan, batch):
+def prepare_stage_data(plan, batch, mode=None):
     """
     HOST half of a batched search: every cascade stage's downsampling of
-    the (D, N) batch, concatenated unpadded into ONE (D, total_samples)
-    wire-dtype array (plus the per-stage offsets). Ships to the device
-    as a single transfer — per-stage transfers each pay the interconnect
-    round-trip latency. Runs in the native threaded runtime when
-    available; callers can invoke this on a worker thread to overlap the
-    next batch's host work with device execution of the current one
-    (ctypes releases the GIL).
+    the (D, N) batch, concatenated unpadded into ONE flat wire buffer in
+    the transport of :func:`_wire_mode` (12-bit packed by default on the
+    kernel path). Ships to the device as a single transfer — per-stage
+    transfers each pay the interconnect round-trip latency. Runs in the
+    native threaded runtime when available; callers can invoke this on a
+    worker thread to overlap the next batch's host work with device
+    execution of the current one (ctypes releases the GIL).
+
+    Returns ``(flat, meta)`` where meta carries the path, wire mode,
+    per-stage offsets/lengths and (uint12) quantisation scales.
     """
     batch = np.asarray(batch, dtype=np.float32)
     if batch.ndim != 2 or batch.shape[1] != plan.size:
         raise ValueError("batch must be (D, N) with N matching the plan")
     path = _ffa_path()
-    wire = _wire_dtype(path)
-    xds = _host_downsample_all(plan, batch, wire)
-    D = batch.shape[0]
-    lens = [st.n for st in plan.stages]
-    flat = np.empty((D, sum(lens)), wire)
-    off = 0
-    for i, st in enumerate(plan.stages):
-        flat[:, off : off + st.n] = xds[i][..., : st.n]
-        off += st.n
-    return flat, path
+    mode = mode or _wire_mode(path)
+    offs, lens, tot = _wire_layout(plan, mode)
+    scales = None
+    if mode == "uint12":
+        flat, scales = _prepare_u12(plan, batch)
+    else:
+        wire = np.dtype(mode)
+        xds = _host_downsample_all(plan, batch, wire)
+        D = batch.shape[0]
+        flat = np.empty((D, tot), wire)
+        for i, st in enumerate(plan.stages):
+            flat[:, offs[i] : offs[i] + st.n] = xds[i][..., : st.n]
+    meta = {"path": path, "mode": mode, "offs": offs, "lens": lens,
+            "scales": scales}
+    return flat, meta
 
 
 def ship_stage_data(plan, prepared):
@@ -358,9 +469,11 @@ def ship_stage_data(plan, prepared):
     in flight). Returns the device parts + stage->(part, offset) map;
     pass to :func:`run_search_batch` as ``shipped`` to start the next
     batch's transfer while the current one computes."""
-    flat, path = prepared
+    flat, meta = prepared
     S = len(plan.stages)
-    starts = np.concatenate([[0], np.cumsum([st.n for st in plan.stages])])
+    starts = np.concatenate(
+        [meta["offs"], [meta["offs"][-1] + meta["lens"][-1]]]
+    )
     nchunks = min(4, S)
     bounds = [int(round(i * S / nchunks)) for i in range(nchunks + 1)]
     parts = []
@@ -369,25 +482,33 @@ def ship_stage_data(plan, prepared):
         parts.append(jnp.asarray(flat[..., int(starts[a]) : int(starts[b])]))
         for i in range(a, b):
             part_of[i] = (c, int(starts[i] - starts[a]))
-    return parts, part_of, path
+    meta = dict(meta)
+    if meta["scales"] is not None:
+        meta["scales_dev"] = jnp.asarray(meta["scales"])
+    return parts, part_of, meta
 
 
 def _queue_stages(plan, batch, prepared=None, shipped=None):
     """Queue every cascade stage on device, from (in order of
     precedence) already-shipped device parts, a prepared host wire
     buffer, or the raw batch. Each stage runs as two dispatches (fused
-    slice+pack, kernel)."""
+    slice+unpack+pack, kernel)."""
     if shipped is None:
         if prepared is None:
             prepared = prepare_stage_data(plan, batch)
         shipped = ship_stage_data(plan, prepared)
-    parts, part_of, path = shipped
+    parts, part_of, meta = shipped
+    path, mode = meta["path"], meta["mode"]
 
     outs = []
     for i, st in enumerate(plan.stages):
         c, off = part_of[i]
         if path == "kernel" and _kernel_eligible(st, plan):
-            outs.append(_run_stage_kernel(st, parts[c], off, plan))
+            outs.append(_run_stage_kernel(st, parts[c], off, plan, meta, i))
+        elif mode == "uint12":
+            xd = _unpack_u12_padded(parts[c], meta["scales_dev"][i], off,
+                                    meta["lens"][i], st.n, plan.nout)
+            outs.append(_run_stage_gather(st, xd, plan))
         else:
             # Gather-path programs are keyed by series length: restore
             # the plan-wide padded length so all stages share one
@@ -398,6 +519,31 @@ def _queue_stages(plan, batch, prepared=None, shipped=None):
                          [(0, 0), (0, plan.nout - st.n)])
             outs.append(_run_stage_gather(st, xd, plan))
     return outs
+
+
+def queue_search_batch(plan, batch, tobs, prepared=None, shipped=None,
+                       **peak_kwargs):
+    """Enqueue one batch's ENTIRE device side — periodogram stages,
+    device assembly, fused peak detection — without syncing. Returns an
+    opaque handle for :func:`collect_search_batch`. Callers pipeline by
+    queueing batch i+1 before collecting batch i, so the device never
+    idles on the host's round trip (through a tunneled device that trip
+    is 0.1-0.4 s)."""
+    from .peaks_device import queue_find_peaks
+
+    pp = _peak_plan(plan, tobs, **peak_kwargs)
+    outs = _queue_stages(plan, batch, prepared=prepared, shipped=shipped)
+    snr_dev = _assemble_device(plan, *outs)
+    return pp, queue_find_peaks(pp, snr_dev)
+
+
+def collect_search_batch(handle, dms):
+    """Sync one queued batch: one device->host pull + host clustering.
+    Returns (peaks_per_trial, polycos_per_trial)."""
+    from .peaks_device import collect_peaks
+
+    pp, peaks_handle = handle
+    return collect_peaks(pp, peaks_handle, dms)
 
 
 def run_search_batch(plan, batch, tobs, dms=None, prepared=None,
@@ -412,15 +558,14 @@ def run_search_batch(plan, batch, tobs, dms=None, prepared=None,
 
     Returns (peaks_per_trial, polycos_per_trial).
     """
-    from .peaks_device import device_find_peaks
-
-    D = np.asarray(batch).shape[0]
+    D = np.asarray(batch).shape[0] if batch is not None else None
+    handle = queue_search_batch(plan, batch, tobs, prepared=prepared,
+                                shipped=shipped, **peak_kwargs)
     if dms is None:
+        if D is None:
+            D = handle[1][1].shape[0]
         dms = np.zeros(D)
-    pp = _peak_plan(plan, tobs, **peak_kwargs)
-    outs = _queue_stages(plan, batch, prepared=prepared, shipped=shipped)
-    snr_dev = _assemble_device(plan, *outs)
-    return device_find_peaks(pp, snr_dev, dms)
+    return collect_search_batch(handle, dms)
 
 
 def run_periodogram(plan, data):
@@ -441,6 +586,33 @@ def run_periodogram(plan, data):
     raw = [np.asarray(o)[0] for o in outs]
     snrs = _assemble(plan, raw)
     return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs
+
+
+def warm_stage_kernels(plan, D, parallel=True):
+    """AOT-compile (or load from the cross-process executable cache)
+    every distinct cycle-kernel bucket a D-trial search of this plan
+    will dispatch. With ``parallel``, buckets compile CONCURRENTLY —
+    Mosaic compiles run in a compiler service, so threads overlap them
+    (measured: two compiles take one compile's wall time). Returns the
+    number of distinct kernel builds warmed."""
+    if _ffa_path() != "kernel":
+        return 0
+    interpret = jax.default_backend() == "cpu"
+    calls = {}
+    for st in plan.stages:
+        if _kernel_eligible(st, plan):
+            c = st.cycle_kernel(interpret=interpret).build(D)
+            if hasattr(c, "warm"):
+                calls.setdefault(id(c), c)
+    if parallel and len(calls) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(4, len(calls))) as ex:
+            list(ex.map(lambda c: c.warm(), calls.values()))
+    else:
+        for c in calls.values():
+            c.warm()
+    return len(calls)
 
 
 def prepare_batch(plan, batch):

@@ -3,27 +3,32 @@ On-device peak detection for batched periodogram searches.
 
 Replicates the reference's find_peaks semantics
 (riptide/peak_detection.py:37-142) while keeping the (D, trials, widths)
-S/N cube on the device; only kilobyte-sized summaries cross to the host:
+S/N cube on the device. The whole detection runs as ONE fused device
+program with ONE device->host pull (~5-10 MB) — through a tunneled
+device, each round trip costs 0.1-0.4 s, so the previous
+stats-pull/host-fit/count-pull/gather-pull sequence dominated the
+post-search latency:
 
 1. device: per-(trial, width) segment percentiles of the S/N column
-   (the reshape + median/IQR of ``segment_stats``) -> (D, NW, nseg, 3)
-   float32, a ~100 KB pull;
-2. host: exact float64 ``np.polyfit`` of the threshold control points
-   (identical math to the reference, which uses float64 numpy);
-3. device: dynamic threshold evaluated from the fitted coefficients,
-   mask ``s > max(dynthr, smin)`` widened by a small epsilon, then
-   per-512-trial-block SELECTED COUNTS -> a ~100 KB pull;
-4. host: picks the non-empty blocks and issues ONE bucketed gather of
-   just those blocks' S/N values (KB-scale), then the exact float64
-   threshold re-check (the epsilon margin absorbs device float32
-   rounding) and the reference's friends-of-friends clustering +
-   per-cluster argmax -> Peak tuples.
+   (the reshape + median/IQR of ``segment_stats``);
+2. device: float32 threshold polyfit in log(f) via precomputed
+   normal-equation matrices (the Vandermonde system is static, so its
+   inverse Gram matrix is a host-built constant);
+3. device: mask ``s > max(dynthr, smin)`` widened by a small epsilon,
+   per-512-trial-block selected counts, and compaction of the first
+   ``CAP`` non-empty blocks per (trial, width) column (rank-by-cumsum +
+   one gather — no sort, fixed shapes);
+4. one pull of {stats, counts, block ids, block values} packed into a
+   single flat buffer (one transfer, not four);
+5. host: exact float64 ``np.polyfit`` re-fit from the pulled stats
+   (identical math to the reference), exact float64 threshold re-check
+   of every pulled point (the epsilon margin absorbs device float32
+   rounding), friends-of-friends clustering + per-cluster argmax ->
+   Peak tuples. Final peaks are bit-identical to the host path.
 
-Candidate counts are data-dependent; blocks make the device outputs
-fixed-shape (counts per block), while the host-driven gather is padded
-to a power-of-two bucket so repeated batches reuse a handful of
-compiled programs. Unlike a fixed top-K buffer there is no overflow
-case — every selected point always reaches the host.
+Columns with more than CAP non-empty blocks (pathological thresholds)
+fall back to the round-trip block gather for the overflow blocks, so
+every selected point still reaches the host.
 """
 import logging
 
@@ -37,7 +42,8 @@ from ..peak_detection import Peak, fit_threshold
 
 log = logging.getLogger("riptide_tpu.peaks_device")
 
-__all__ = ["PeakPlan", "device_find_peaks"]
+__all__ = ["PeakPlan", "device_find_peaks", "queue_find_peaks",
+           "collect_peaks"]
 
 # Margin (in S/N units) by which the device-side threshold is lowered;
 # marginal points are re-judged on host in float64. Device f32 rounding
@@ -71,17 +77,27 @@ class PeakPlan:
         # log-f evaluation grid (device side, float32).
         self.fc = np.median(freqs[: nseg * pts].reshape(nseg, pts), axis=1)
         self.logf = np.log(freqs).astype(np.float32)
+        # Static least-squares operator of the threshold fit: the
+        # control-point frequencies are fixed at plan time, so
+        # polyfit(log fc, tc) reduces to one matmul coef = fitmat @ tc.
+        # Built in float64, applied in float32 on device; the exact
+        # float64 np.polyfit re-fit happens on host in _finalize.
+        V = np.vander(np.log(self.fc), self.polydeg + 1)
+        self.fitmat = (np.linalg.inv(V.T @ V) @ V.T).astype(np.float32)
 
     # -- step 1: device segment stats ------------------------------------
 
-    @partial(jax.jit, static_argnames=("self",))
-    def _stats(self, snr):
-        """snr: (D, n, NW) f32 -> (D, NW, nseg, 3) [p25, p50, p75]."""
+    def _stats_impl(self, snr):
         seg = snr[:, : self.nseg * self.pts, :]
         D, _, NW = seg.shape
         seg = seg.transpose(0, 2, 1).reshape(D, NW, self.nseg, self.pts)
         q = jnp.percentile(seg, jnp.asarray([25.0, 50.0, 75.0]), axis=-1)
         return q.transpose(1, 2, 3, 0)  # (D, NW, nseg, 3)
+
+    @partial(jax.jit, static_argnames=("self",))
+    def _stats(self, snr):
+        """snr: (D, n, NW) f32 -> (D, NW, nseg, 3) [p25, p50, p75]."""
+        return self._stats_impl(snr)
 
     # -- step 2: host polyfit --------------------------------------------
 
@@ -116,15 +132,13 @@ class PeakPlan:
     # either costs seconds per batch at this width).
 
     BLK = 512
+    CAP = 16  # non-empty blocks gathered on device per (trial, width)
 
     @property
     def _nb(self):
         return -(-self.n // self.BLK)
 
-    @partial(jax.jit, static_argnames=("self",))
-    def _block_counts(self, snr, polyco):
-        """snr (D, n, NW), polyco (D, NW, deg+1) f32 ->
-        cnt (D, NW, nb) int32 of threshold-selected points per block."""
+    def _counts_impl(self, snr, polyco):
         logf = jnp.asarray(self.logf)
         # Horner evaluation of the threshold polynomial at every trial.
         thr = jnp.zeros(polyco.shape[:2] + (self.n,), jnp.float32)
@@ -136,6 +150,63 @@ class PeakPlan:
         pad = self._nb * self.BLK - n
         mask = jnp.pad(mask, [(0, 0), (0, 0), (0, pad)])
         return mask.reshape(D, NW, self._nb, self.BLK).sum(-1).astype(jnp.int32)
+
+    @partial(jax.jit, static_argnames=("self",))
+    def _block_counts(self, snr, polyco):
+        """snr (D, n, NW), polyco (D, NW, deg+1) f32 ->
+        cnt (D, NW, nb) int32 of threshold-selected points per block."""
+        return self._counts_impl(snr, polyco)
+
+    # -- fused single-pull program ---------------------------------------
+
+    @partial(jax.jit, static_argnames=("self",))
+    def _fused(self, snr):
+        """The whole device side in one program: stats, f32 threshold
+        fit, block counts, and compaction of the first CAP non-empty
+        blocks per column. Returns ONE flat float32 buffer
+        [stats | cnt (bitcast) | ids (bitcast) | vals] so the host pays
+        a single transfer."""
+        stats = self._stats_impl(snr)                   # (D, NW, nseg, 3)
+        D, NW = stats.shape[:2]
+        if self.nseg >= self.minseg:
+            tc = stats[..., 1] + self.nstd * (stats[..., 2] - stats[..., 0]) / 1.349
+            coef = jnp.einsum("ks,dws->dwk", jnp.asarray(self.fitmat), tc)
+        else:
+            coef = jnp.zeros((D, NW, self.polydeg + 1), jnp.float32)
+            coef = coef.at[..., -1].set(self.smin)
+        cnt = self._counts_impl(snr, coef)              # (D, NW, nb)
+        nb, BLK, CAP = self._nb, self.BLK, self.CAP
+        nz = cnt > 0
+        rank = jnp.cumsum(nz.astype(jnp.int32), axis=-1) - 1
+        oh = (nz & (rank < CAP))[..., None] & (
+            rank[..., None] == jnp.arange(CAP, dtype=jnp.int32)
+        )                                               # (D, NW, nb, CAP)
+        bids = jnp.arange(nb, dtype=jnp.int32)[None, None, :, None]
+        ids = jnp.sum(jnp.where(oh, bids, 0), axis=2)   # (D, NW, CAP)
+        ids = jnp.where(jnp.any(oh, axis=2), ids, -1)
+        s = snr.transpose(0, 2, 1)
+        s = jnp.pad(s, [(0, 0), (0, 0), (0, nb * BLK - self.n)],
+                    constant_values=-jnp.inf)
+        sblk = s.reshape(D, NW, nb, BLK)
+        vals = jnp.take_along_axis(
+            sblk, jnp.clip(ids, 0, nb - 1)[..., None], axis=2
+        )                                               # (D, NW, CAP, BLK)
+        f32 = partial(jax.lax.bitcast_convert_type, new_dtype=jnp.float32)
+        return jnp.concatenate(
+            [stats.ravel(), f32(cnt).ravel(), f32(ids).ravel(), vals.ravel()]
+        )
+
+    def _unpack(self, buf, D):
+        NW, nseg, nb, CAP, BLK = (len(self.plan.widths), self.nseg,
+                                  self._nb, self.CAP, self.BLK)
+        sizes = [D * NW * nseg * 3, D * NW * nb, D * NW * CAP,
+                 D * NW * CAP * BLK]
+        offs = np.concatenate([[0], np.cumsum(sizes)])
+        stats = buf[offs[0]:offs[1]].reshape(D, NW, nseg, 3)
+        cnt = buf[offs[1]:offs[2]].view(np.int32).reshape(D, NW, nb)
+        ids = buf[offs[2]:offs[3]].view(np.int32).reshape(D, NW, CAP)
+        vals = buf[offs[3]:offs[4]].reshape(D, NW, CAP, BLK)
+        return stats, cnt, ids, vals
 
     @partial(jax.jit, static_argnames=("self",))
     def _gather_blocks(self, snr, flat_ids):
@@ -190,32 +261,74 @@ class PeakPlan:
         )
 
 
-def device_find_peaks(peak_plan, snr_dev, dms):
-    """
-    Run the 4-step on-device peak detection.
+def queue_find_peaks(peak_plan, snr_dev):
+    """Enqueue the fused peak-detection program; returns an opaque
+    handle without syncing, so callers can enqueue the NEXT batch's
+    device work before paying this batch's device->host round trip."""
+    snr_dev = jnp.asarray(snr_dev)
+    # A mutable handle: collect_peaks nulls the entries to release the
+    # device buffers even while the caller still holds the handle
+    # (queue-ahead pipelining keeps two batches' handles live at once).
+    return [peak_plan._fused(snr_dev), snr_dev]
 
-    Parameters
-    ----------
-    peak_plan : PeakPlan
-    snr_dev : (D, n_trials, NW) device array (or anything jnp.asarray
-        accepts) of S/N values in plan trial order
-    dms : (D,) DM value per batch row
+
+def collect_peaks(peak_plan, handle, dms):
+    """Pull the fused buffer (ONE transfer) and finish on host: exact
+    float64 threshold re-fit/re-check + clustering -> Peak tuples.
 
     Returns (peaks_per_trial, polycos_per_trial) where peaks_per_trial[d]
     is a list of Peak sorted by decreasing S/N — the contract of the
     host ``find_peaks`` (riptide/peak_detection.py:146-222).
     """
     plan = peak_plan.plan
-    snr_dev = jnp.asarray(snr_dev)
-    stats = np.asarray(peak_plan._stats(snr_dev))          # pull ~100 KB
+    buf_dev, snr_dev = handle
+    D = snr_dev.shape[0]
+    buf = np.asarray(buf_dev)                              # the one pull
+    handle[0] = buf_dev = None
+    stats, cnt, ids, vals = peak_plan._unpack(buf, D)
+    # The S/N cube is only needed again for the (pathological) overflow
+    # gather below; release it as soon as the counts show no column
+    # overflowed its CAP-block budget.
+    if not ((cnt > 0).sum(axis=2) > peak_plan.CAP).any():
+        handle[1] = snr_dev = None
+    NW, nb, BLK, CAP = (cnt.shape[1], peak_plan._nb, peak_plan.BLK,
+                        peak_plan.CAP)
     polyco = peak_plan._fit(stats)
-    cnt = np.asarray(peak_plan._block_counts(
-        snr_dev, jnp.asarray(polyco, dtype=jnp.float32)
-    ))
-    D, NW, nb = cnt.shape
-    sel = np.argwhere(cnt > 0)
+    off = np.arange(BLK)
     cols = {}
-    if sel.size:
+
+    def add(d, iw, b, row):
+        pos = b * BLK + off
+        ok = pos < peak_plan.n
+        # every point of a selected block comes home; the exact float64
+        # threshold cut happens in _finalize
+        key = (int(d), int(iw))
+        ix, sv = pos[ok].astype(np.int64), row[ok].astype(np.float64)
+        if key in cols:
+            pix, psv = cols[key]
+            cols[key] = (np.concatenate([pix, ix]), np.concatenate([psv, sv]))
+        else:
+            cols[key] = (ix, sv)
+
+    for d, iw in zip(*np.nonzero((ids >= 0).any(axis=2))):
+        for c in range(CAP):
+            b = ids[d, iw, c]
+            if b < 0:
+                break
+            add(d, iw, b, vals[d, iw, c])
+
+    # Overflow: a column with more than CAP non-empty blocks (threshold
+    # pathologically low) falls back to the round-trip bucketed gather
+    # for the blocks the fused program could not carry home.
+    over = np.argwhere((cnt > 0).sum(axis=2) > CAP)
+    if over.size:
+        sel = []
+        for d, iw in over:
+            bs = np.nonzero(cnt[d, iw])[0][CAP:]
+            sel.extend((d, iw, b) for b in bs)
+        sel = np.asarray(sel)
+        log.warning("peak block overflow: %d extra blocks in %d columns",
+                    len(sel), len(over))
         flat_ids = ((sel[:, 0] * NW + sel[:, 1]) * nb + sel[:, 2]).astype(
             np.int32
         )
@@ -224,25 +337,26 @@ def device_find_peaks(peak_plan, snr_dev, dms):
         bucket = max(64, 1 << int(np.ceil(np.log2(len(flat_ids)))))
         padded = np.zeros(bucket, np.int32)
         padded[: len(flat_ids)] = flat_ids
-        vals = np.asarray(peak_plan._gather_blocks(
+        gvals = np.asarray(peak_plan._gather_blocks(
             snr_dev, jnp.asarray(padded)
-        ))[: len(flat_ids)].astype(np.float64)
-        BLK = peak_plan.BLK
-        off = np.arange(BLK)
-        for row, (d, iw, b) in zip(vals, sel):
-            pos = b * BLK + off
-            ok = pos < peak_plan.n
-            # every point of a selected block comes home; the exact
-            # float64 threshold cut happens in _finalize
-            ix = pos[ok]
-            sv = row[ok]
-            key = (int(d), int(iw))
-            if key in cols:
-                pix, psv = cols[key]
-                cols[key] = (np.concatenate([pix, ix]),
-                             np.concatenate([psv, sv]))
-            else:
-                cols[key] = (ix.astype(np.int64), sv)
+        ))[: len(flat_ids)]
+        handle[1] = snr_dev = None
+        for row, (d, iw, b) in zip(gvals, sel):
+            add(d, iw, b, row)
+
     return peak_plan._finalize(
         cols, polyco, plan.widths, plan.all_foldbins, dms, D, NW
     )
+
+
+def device_find_peaks(peak_plan, snr_dev, dms):
+    """Run the fused on-device peak detection (queue + collect in one).
+
+    Parameters
+    ----------
+    peak_plan : PeakPlan
+    snr_dev : (D, n_trials, NW) device array (or anything jnp.asarray
+        accepts) of S/N values in plan trial order
+    dms : (D,) DM value per batch row
+    """
+    return collect_peaks(peak_plan, queue_find_peaks(peak_plan, snr_dev), dms)

@@ -1,0 +1,7 @@
+"""riptide_tpu test suite.
+
+Lives at the repository root as ``tests/`` and installs as
+``riptide_tpu.tests`` (see pyproject's package-dir mapping) so
+``riptide_tpu.test()`` also works from an installed tree, mirroring the
+reference's in-package test layout (riptide/tests/__init__.py:5-10).
+"""

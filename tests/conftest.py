@@ -10,22 +10,51 @@ reads. ``jax.config.update`` works post-import as long as no backend has
 been initialised yet, which is the case at conftest import time.
 """
 import os
+import sys
 
-# Effective when jax was NOT pre-imported by sitecustomize (e.g. running
-# with PALLAS_AXON_POOL_IPS unset); harmless otherwise.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    # Read at CPU backend initialisation, which has not happened yet.
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+import pytest
+
+# Test modules import shared helpers as plain modules (`from synth
+# import ...`); keep that working both from a checkout (tests/) and from
+# the installed riptide_tpu.tests package.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+_TPU_MODE = os.environ.get("RIPTIDE_TESTS_TPU") == "1"
+
+if not _TPU_MODE:
+    # Effective when jax was NOT pre-imported by sitecustomize (e.g.
+    # running with PALLAS_AXON_POOL_IPS unset); harmless otherwise.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # Read at CPU backend initialisation, which has not happened yet.
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 # Persistent compilation cache: kernel shapes repeat across test runs.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/riptide_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 import jax  # noqa: E402
 
-# Effective even when sitecustomize already imported jax with
-# JAX_PLATFORMS=axon: config updates apply until first backend use.
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_MODE:
+    # Effective even when sitecustomize already imported jax with
+    # JAX_PLATFORMS=axon: config updates apply until first backend use.
+    jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: needs the real TPU backend (run via `make tests-tpu`; "
+        "skipped in the default CPU suite)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if _TPU_MODE:
+        return
+    skip = pytest.mark.skip(reason="TPU-only; run `make tests-tpu`")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)

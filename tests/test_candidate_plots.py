@@ -80,6 +80,22 @@ def test_candidate_json_roundtrip(candidate, tmp_path):
     assert out.tsmeta["source_name"] == candidate.tsmeta["source_name"]
 
 
+def test_render_spawned_parallel_plots(candidate, tmp_path):
+    """Candidate PNGs render concurrently in spawned CPU-only workers
+    (parallel-plotting parity with the reference's process pool,
+    riptide/pipeline/pipeline.py:370-379)."""
+    from riptide_tpu.pipeline.pipeline import CandidateWriter, render_spawned
+
+    writer = CandidateWriter(str(tmp_path), plot=True)
+    arglist = list(enumerate([candidate] * 3))
+    render_spawned(writer, arglist, processes=2)
+    for rank in range(3):
+        png = tmp_path / f"candidate_{rank:04d}.png"
+        jsn = tmp_path / f"candidate_{rank:04d}.json"
+        assert png.exists() and png.stat().st_size > 0
+        assert jsn.exists() and jsn.stat().st_size > 0
+
+
 @pytest.fixture(scope="module")
 def pgram():
     np.random.seed(2)

@@ -96,6 +96,25 @@ def test_cycle_kernel_interpret_matches_oracle(ms, ps):
     _check_kernel(k, out, ms, ps, datas, widths, std)
 
 
+def test_cycle_kernel_streaming_tables(monkeypatch):
+    """The per-level table-streaming fallback (used when the resident
+    all-levels scratch would blow the VMEM budget) stays oracle-exact.
+    Forced via monkeypatch — it only triggers naturally at shapes too
+    large for interpret mode."""
+    from riptide_tpu.ops import ffa_kernel
+
+    monkeypatch.setattr(ffa_kernel, "tables_resident",
+                        lambda *a: False)
+    ffa_kernel._build_call.cache_clear()
+    try:
+        ms, ps = [37, 29, 1], [33, 40, 33]
+        k, x, datas, widths, std = _kernel_case(ms, ps, (1, 2, 3, 4, 6))
+        out = k(x)
+        _check_kernel(k, out, ms, ps, datas, widths, std)
+    finally:
+        ffa_kernel._build_call.cache_clear()
+
+
 def test_cycle_kernel_dm_batch_axis():
     """(D, B, rows, P) input: every DM trial matches its own oracle."""
     ms, ps = [37, 29], [33, 40]

@@ -84,3 +84,31 @@ def test_device_peaks_noise_only(search_setup):
     # pure-noise trial: no (or only marginal) detections above smin
     for p in dev[1]:
         assert p.snr < 8.0
+
+
+def test_queue_collect_pipelining(search_setup):
+    """Two batches queued BEFORE either is collected (the queue-ahead
+    pattern of the batcher/benchmark) must produce the same peaks as
+    two sequential run_search_batch calls, and collecting must release
+    the handle's device buffers."""
+    from riptide_tpu.search.engine import (
+        collect_search_batch, queue_search_batch,
+    )
+
+    plan, batch = search_setup
+    dms = [0.0, 10.0, 20.0]
+    want, _ = run_search_batch(plan, batch, tobs=N * TSAMP, dms=dms, **PKW)
+
+    h1 = queue_search_batch(plan, batch, tobs=N * TSAMP, **PKW)
+    h2 = queue_search_batch(plan, batch[::-1].copy(), tobs=N * TSAMP, **PKW)
+    got1, _ = collect_search_batch(h1, dms)
+    got2, _ = collect_search_batch(h2, dms[::-1])
+
+    def key(trials):
+        return [[(p.ip, p.iw, round(p.snr, 4)) for p in t] for t in trials]
+
+    assert key(got1) == key(want)
+    assert key(got2) == key(want[::-1])
+    # collect released the fused buffer (and the S/N cube unless a
+    # column overflowed, which these tiny searches never do)
+    assert h1[1][0] is None and h1[1][1] is None

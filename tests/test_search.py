@@ -112,3 +112,23 @@ def test_snr_parity_oracle():
     assert abs(1.0 / pgram.periods[ip] - 1.0) < 0.1 / 128.0
     assert int(pgram.widths[iw]) == 13
     assert abs(best_snr - 18.5) < 0.15
+
+
+@pytest.mark.parametrize("wire", ["float16", "uint12"])
+def test_snr_parity_oracle_lossy_wire(monkeypatch, wire):
+    """The lossy host->device wire transports (float16, and the 12-bit
+    packed default of the TPU kernel path — search/engine.py:_wire_mode)
+    must hold the same 18.5 +/- 0.15 oracle bar: float16's ~5e-4
+    relative rounding and uint12's max/4094 quantisation step are both
+    S/N errors of order 0.01. Exercised through the CPU gather path,
+    which applies the identical cast/decode."""
+    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", wire)
+    np.random.seed(0)
+    ts = TimeSeries.generate(length=128.0, tsamp=256e-6, period=1.0, amplitude=20.0, ducy=0.02)
+    _, pgram = ffa_search(
+        ts, period_min=0.5, period_max=2.0, bins_min=480, bins_max=520, ducy_max=0.3
+    )
+    ip, iw = np.unravel_index(np.argmax(pgram.snrs), pgram.snrs.shape)
+    assert abs(1.0 / pgram.periods[ip] - 1.0) < 0.1 / 128.0
+    assert int(pgram.widths[iw]) == 13
+    assert abs(pgram.snrs[ip, iw] - 18.5) < 0.15

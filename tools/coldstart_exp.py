@@ -1,0 +1,117 @@
+"""Cold-start experiments on the real TPU:
+
+1. Can a compiled Pallas executable be serialized with
+   jax.experimental.serialize_executable and reloaded (in THIS process)?
+   (Cross-process reload is tested by running the script twice: pass
+   `load` to skip compilation and deserialize from disk.)
+2. Do two Mosaic compiles overlap when issued from two Python threads?
+
+Usage: python tools/coldstart_exp.py [load]
+"""
+import os
+import pickle
+import sys
+import time
+
+import numpy as np
+
+CACHE = "/tmp/riptide_exec_cache"
+
+
+def small_kernel(bins):
+    from riptide_tpu.ops.ffa_kernel import CycleKernel
+    from riptide_tpu.ops.snr import boxcar_coeffs
+
+    ms = [121, 118]
+    ps = [bins, bins + 4]
+    widths = (1, 2, 3)
+    h = np.zeros((2, 3), np.float32)
+    b = np.zeros((2, 3), np.float32)
+    for i, p in enumerate(ps):
+        h[i], b[i] = boxcar_coeffs(p, widths)
+    k = CycleKernel(ms, ps, widths, h, b, np.ones(2, np.float32))
+    x = np.random.default_rng(0).standard_normal(
+        (2, k.rows, k.P)).astype(np.float32)
+    return k, x
+
+
+def main():
+    import jax
+    from jax.experimental import serialize_executable as se
+
+    os.makedirs(CACHE, exist_ok=True)
+    path = os.path.join(CACHE, "k64.pkl")
+
+    k, x = small_kernel(64)
+    scal, coef, wrep = k._operands()
+    from riptide_tpu.ops.ffa_kernel import _build_call
+
+    call = _build_call(k.L, k.NL, k.rows, k.P, k.RS, k.widths, k.nspread,
+                       k.pbits, 1, k.B, False)
+    args = (scal, coef, x[None], wrep)
+
+    if "load" in sys.argv[1:]:
+        t0 = time.perf_counter()
+        with open(path, "rb") as f:
+            payload, in_tree, out_tree = pickle.load(f)
+        loaded = se.deserialize_and_load(payload, in_tree, out_tree)
+        print(f"deserialize: {time.perf_counter()-t0:.1f}s", flush=True)
+        t0 = time.perf_counter()
+        out = loaded(*args)
+        v = float(np.asarray(out)[0, 0, 0, 0])
+        print(f"run-from-cache: {time.perf_counter()-t0:.1f}s val={v:.3f}",
+              flush=True)
+        return
+
+    t0 = time.perf_counter()
+    lowered = jax.jit(call).lower(*args)
+    compiled = lowered.compile()
+    print(f"compile: {time.perf_counter()-t0:.1f}s", flush=True)
+    t0 = time.perf_counter()
+    payload, in_tree, out_tree = se.serialize(compiled)
+    with open(path, "wb") as f:
+        pickle.dump((payload, in_tree, out_tree), f)
+    print(f"serialize: {time.perf_counter()-t0:.1f}s "
+          f"({os.path.getsize(path)/1e6:.1f} MB)", flush=True)
+    t0 = time.perf_counter()
+    out = compiled(*args)
+    v = float(np.asarray(out)[0, 0, 0, 0])
+    print(f"run: {time.perf_counter()-t0:.1f}s val={v:.3f}", flush=True)
+
+    # same-process reload sanity
+    with open(path, "rb") as f:
+        payload, in_tree, out_tree = pickle.load(f)
+    loaded = se.deserialize_and_load(payload, in_tree, out_tree)
+    v2 = float(np.asarray(loaded(*args))[0, 0, 0, 0])
+    assert v2 == v, (v, v2)
+    print("same-process reload OK", flush=True)
+
+    # experiment 2: threaded compile overlap (two DISTINCT kernels)
+    import threading
+
+    k2, x2 = small_kernel(96)
+    k3, x3 = small_kernel(128)
+
+    def compile_one(kk, xx):
+        t0 = time.perf_counter()
+        float(np.asarray(kk(xx)[0, 0, 0]))
+        return time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ts = []
+    res = {}
+    for name, (kk, xx) in {"A": (k2, x2), "B": (k3, x3)}.items():
+        th = threading.Thread(
+            target=lambda n=name, kk=kk, xx=xx: res.update({n: compile_one(kk, xx)})
+        )
+        th.start()
+        ts.append(th)
+    for th in ts:
+        th.join()
+    wall = time.perf_counter() - t0
+    print(f"threaded 2-compile wall: {wall:.1f}s, individual: {res}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,7 @@ from riptide_tpu.ops.ffa_kernel import CycleKernel
 from riptide_tpu.ops.snr import boxcar_coeffs
 
 
-def run(ms, ps, widths=(1, 2, 3, 4, 6, 9, 13, 19, 28, 42), reps=10):
+def run(ms, ps, widths=(1, 2, 3, 4, 6, 9, 13, 19, 28, 42), reps=10, D=1):
     widths = tuple(w for w in widths if w < min(ps))
     B = len(ms)
     nw = len(widths)
@@ -20,19 +20,24 @@ def run(ms, ps, widths=(1, 2, 3, 4, 6, 9, 13, 19, 28, 42), reps=10):
     std = np.ones(B, np.float32)
     k = CycleKernel(ms, ps, widths, h, b, std)
     rng = np.random.default_rng(0)
-    x = rng.standard_normal((B, k.rows, k.P)).astype(np.float32)
+    shape = (B, k.rows, k.P) if D == 1 else (D, B, k.rows, k.P)
+    x = rng.standard_normal(shape).astype(np.float32)
     import jax.numpy as jnp
 
+    t0 = time.perf_counter()
     xd = jax.device_put(x)
+    ix = (0, 0, 0) if D == 1 else (0, 0, 0, 0)
+    print(f"  device_put({x.nbytes/1e6:.0f} MB): "
+          f"{time.perf_counter()-t0:.1f}s", flush=True)
     # Warm up + true sync (block_until_ready does not sync under the
     # axon tunnel; only a real device->host fetch does).
     t0 = time.perf_counter()
-    float(np.asarray(k(xd)[0, 0, 0]))
+    float(np.asarray(k(xd)[ix]))
     print(f"  warmup (compile): {time.perf_counter()-t0:.1f}s", flush=True)
 
     def run(reps):
         t0 = time.perf_counter()
-        vals = [k(xd)[0, 0, 0] for _ in range(reps)]
+        vals = [k(xd)[ix] for _ in range(reps)]
         s = float(np.asarray(jnp.stack(vals)).sum())  # ONE fetch
         assert np.isfinite(s)
         dt = time.perf_counter() - t0
@@ -43,15 +48,22 @@ def run(ms, ps, widths=(1, 2, 3, 4, 6, 9, 13, 19, 28, 42), reps=10):
     t1 = min(run(r1) for _ in range(2))
     t2 = min(run(r2) for _ in range(2))
     dt = (t2 - t1) / (r2 - r1)
-    adds = sum(m * p * np.ceil(np.log2(max(m, 2))) for m, p in zip(ms, ps))
+    adds = D * sum(m * p * np.ceil(np.log2(max(m, 2))) for m, p in zip(ms, ps))
     print(
-        f"bucket B={B} rows={k.rows} P={k.P}: {dt*1e3:.2f} ms/call "
+        f"bucket D={D} B={B} rows={k.rows} P={k.P}: {dt*1e3:.2f} ms/call, "
+        f"{dt*1e3/(D*B):.3f} ms/program "
         f"({adds/1e6:.0f} M useful adds, {adds/dt/1e9:.1f} G adds/s)"
     )
     return dt
 
 
-if __name__ == "__main__":
+def main(argv):
+    D = int(argv[1]) if len(argv) > 1 else 1
+    reps = int(argv[2]) if len(argv) > 2 else 10
     ms = [1046 - 4 * i for i in range(21)]
     ps = list(range(240, 261))
-    run(ms, ps)
+    run(ms, ps, reps=reps, D=D)
+
+
+if __name__ == "__main__":
+    main(sys.argv)

@@ -1,0 +1,17 @@
+"""Warm the headline-bench kernel buckets via the executable cache.
+Usage: python tools/warmtest.py [D] [seq]"""
+import sys
+import time
+
+from riptide_tpu.ffautils import generate_width_trials
+from riptide_tpu.search import periodogram_plan
+from riptide_tpu.search.engine import warm_stage_kernels
+
+D = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+par = "seq" not in sys.argv[1:]
+widths = tuple(int(w) for w in generate_width_trials(240))
+plan = periodogram_plan(1 << 23, 64e-6, widths, 0.5, 3.0, 240, 260)
+t0 = time.perf_counter()
+n = warm_stage_kernels(plan, D, parallel=par)
+print(f"warmed {n} kernel builds (parallel={par}) in "
+      f"{time.perf_counter()-t0:.1f}s", flush=True)
