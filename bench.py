@@ -74,16 +74,18 @@ def _parity_gate(plan, batch, tobs):
     from riptide_tpu.peak_detection import find_peaks
     from riptide_tpu.periodogram import Periodogram
     from riptide_tpu.search.engine import (
-        _assemble_device, _queue_stages, run_search_batch,
+        collect_search_batch, queue_search_batch, search_snr_dev,
     )
 
-    outs = _queue_stages(plan, batch)
-    snr0 = _np.asarray(_assemble_device(plan, *outs)[0])  # one trial's cube
+    # ONE search serves both sides of the gate: trial 0's S/N column is
+    # pulled from the same queued batch the on-device path collects.
+    handle = queue_search_batch(plan, batch, tobs=tobs, **PKW)
+    snr0 = _np.asarray(search_snr_dev(handle)[0])  # one trial's cube
     md = Metadata({"dm": 0.0, "tobs": tobs})
     pgram = Periodogram(plan.widths, plan.all_periods, plan.all_foldbins,
                         snr0, md)
     host_peaks, _ = find_peaks(pgram, **PKW)
-    dev_peaks_all, _ = run_search_batch(plan, batch, tobs=tobs, **PKW)
+    dev_peaks_all, _ = collect_search_batch(handle, _np.zeros(len(batch)))
     dev_peaks = dev_peaks_all[0]
 
     hset = [(p.ip, p.iw, round(p.snr, 3)) for p in host_peaks]

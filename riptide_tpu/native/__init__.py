@@ -28,6 +28,7 @@ __all__ = [
     "downsample",
     "downsample_stages",
     "prepare_wire_u12",
+    "prepare_wire_u8",
     "circular_prefix_sum",
     "boxcar_snr",
 ]
@@ -128,6 +129,18 @@ def _bind(lib):
         _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"),
         c64, c64, c64, ctypes.c_int,              # S, nout, nthreads, as_f16
         ctypes.c_void_p,                          # out (S, D, nout)
+    ]
+    lib.rn_prepare_wire_u8.restype = None
+    lib.rn_prepare_wire_u8.argtypes = [
+        _f32("C_CONTIGUOUS"), c64, c64,           # batch, D, N
+        i32p, i32p,                               # imin, imax (S, nout_pad)
+        _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"),
+        c64, c64,                                 # S, nout_pad
+        i32p, i64p,                               # nouts (S,), boffs (S,)
+        c64, i64p, c64,                           # totbytes, soffs, totscales
+        c64, c64,                                 # blkq, nthreads
+        _f32("C_CONTIGUOUS"),                     # scales out (D, totscales)
+        ctypes.c_void_p,                          # out (D, totbytes) u8
     ]
     lib.rn_prepare_wire_u12.restype = None
     lib.rn_prepare_wire_u12.argtypes = [
@@ -325,6 +338,43 @@ def prepare_wire_u12(batch, imin, imax, wmin, wmax, wint, nouts, boffs,
         np.ascontiguousarray(nouts, np.int32),
         np.ascontiguousarray(boffs, np.int64),
         int(totbytes), int(nthreads),
+        scales, out.ctypes.data,
+    )
+    return out, scales
+
+
+def prepare_wire_u8(batch, imin, imax, wmin, wmax, wint, nouts, boffs,
+                    totbytes, soffs, totscales, blkq=256, nthreads=None):
+    """
+    8-bit block-adaptive wire preparation of a (D, N) float32 batch:
+    per-stage real-factor downsampling, one byte per sample with a
+    per-``blkq``-sample-block scale = blockmax / 127 (bias 128), written
+    straight into the (D, totbytes) wire layout; block scales go to a
+    (D, totscales) float32 array with stage s at ``soffs[s]``.
+
+    Returns (wire (D, totbytes) uint8, scales (D, totscales) float32).
+    """
+    lib = _require()
+    batch = np.ascontiguousarray(batch, np.float32)
+    D, N = batch.shape
+    S, nout_pad = imin.shape
+    if nthreads is None:
+        nthreads = min(max(os.cpu_count() or 1, 1), 32)
+    out = np.empty((D, int(totbytes)), np.uint8)
+    scales = np.empty((D, int(totscales)), np.float32)
+    lib.rn_prepare_wire_u8(
+        batch, D, N,
+        np.ascontiguousarray(imin, np.int32),
+        np.ascontiguousarray(imax, np.int32),
+        np.ascontiguousarray(wmin, np.float32),
+        np.ascontiguousarray(wmax, np.float32),
+        np.ascontiguousarray(wint, np.float32),
+        S, nout_pad,
+        np.ascontiguousarray(nouts, np.int32),
+        np.ascontiguousarray(boffs, np.int64),
+        int(totbytes),
+        np.ascontiguousarray(soffs, np.int64), int(totscales),
+        int(blkq), int(nthreads),
         scales, out.ctypes.data,
     )
     return out, scales

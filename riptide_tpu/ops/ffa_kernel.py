@@ -31,7 +31,6 @@ import functools
 import hashlib
 import logging
 import os
-import pickle
 import tempfile
 import threading
 
@@ -348,6 +347,8 @@ class _CachedCall:
 
     def warm(self):
         """Compile (or load) the executable without running it."""
+        from ..utils.exec_cache import load_or_compile_exec
+
         with self._lock:
             if self._fn is not None:
                 return
@@ -358,37 +359,15 @@ class _CachedCall:
             if not tpu or os.environ.get("RIPTIDE_KERNEL_CACHE") == "off":
                 self._fn = self.jitted
                 return
-            from jax.experimental import serialize_executable as se
-
-            path = _exec_cache_path(self.key)
-            if os.path.exists(path):
-                try:
-                    with open(path, "rb") as f:
-                        payload, in_tree, out_tree = pickle.load(f)
-                    self._fn = se.deserialize_and_load(
-                        payload, in_tree, out_tree)
-                    log.debug("kernel executable loaded from %s", path)
-                    return
-                except Exception as err:
-                    log.warning("kernel cache load failed (%s); recompiling",
-                                err)
             try:
-                compiled = self.jitted.lower(*self._aot_args()).compile()
+                self._fn = load_or_compile_exec(
+                    _exec_cache_path(self.key), self.jitted,
+                    self._aot_args(), name=f"cycle_kernel{self.key}",
+                )
             except Exception as err:
                 log.warning("AOT kernel compile failed (%s); "
                             "falling back to jit", err)
                 self._fn = self.jitted
-                return
-            try:
-                os.makedirs(_EXEC_DIR, mode=0o700, exist_ok=True)
-                payload = se.serialize(compiled)
-                fd, tmp = tempfile.mkstemp(dir=_EXEC_DIR, suffix=".tmp")
-                with os.fdopen(fd, "wb") as f:
-                    pickle.dump(payload, f)
-                os.replace(tmp, path)
-            except Exception as err:
-                log.warning("kernel cache store failed (%s)", err)
-            self._fn = compiled
 
     def __call__(self, *args):
         if self._fn is None:

@@ -31,12 +31,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.downsample import downsample_gather, split_prefix_sums
+from ..utils.exec_cache import cached_jit
 from ..ops.ffa import ffa_levels
 from ..ops.ffa_kernel import NWPAD
 from ..ops.snr import snr_batched
 
 __all__ = ["run_periodogram", "run_periodogram_batch", "run_search_batch",
-           "queue_search_batch", "collect_search_batch", "cycle_fn"]
+           "queue_search_batch", "collect_search_batch", "search_snr_dev",
+           "cycle_fn"]
 
 
 def _pack(xd, p, m, R, P):
@@ -164,7 +166,7 @@ def _peak_plan(plan, tobs, **peak_kwargs):
     return pp
 
 
-@partial(jax.jit, static_argnames=("off", "n", "shapes", "rows", "P"))
+@cached_jit(static_argnames=("off", "n", "shapes", "rows", "P"))
 def _pack_static(flat, off, n, shapes, rows, P):
     """
     Static pack, fused with the stage's slice of the all-stages wire
@@ -184,37 +186,56 @@ def _pack_static(flat, off, n, shapes, rows, P):
 
 
 def _wire_mode(path):
-    """Host->device wire transport for downsampled stage data.
+    """Host->device wire transport for downsampled stage data. Through
+    a ~20-70 MB/s tunneled device the wire is the survey throughput
+    ceiling, so bytes are the metric that matters.
 
-    'uint12' (default on the kernel path): 12-bit quantisation, two
-    samples in three bytes, per-(stage, trial) scale = max|v| / 2047.
-    Quantisation error is <= max/4094 per sample — an S/N error of the
-    same ~0.01 order as the float16 wire's (both enforced against the
-    18.5 +/- 0.15 oracle by tests) — at 75% of float16's bytes; through
-    a ~50 MB/s tunneled device the wire is the survey throughput
-    ceiling, so bytes are the metric that matters. 'float16' costs
-    ~5e-4 relative per sample; 'float32' is exact (gather-path
-    default). Override with RIPTIDE_WIRE_DTYPE=float32|float16|uint12.
+    'uint8' (default on the kernel path): one byte per sample with a
+    per-256-sample-block scale = blockmax / 127 — block adaptivity
+    confines coarse steps to the (rare) bright-signal blocks while
+    noise blocks quantise at ~4 sigma / 127; measured S/N error at the
+    18.5 oracle is ~0.01 (enforced by tests), at half float16's bytes.
+    'uint12': 12-bit, two samples in three bytes, per-(stage, trial)
+    scale (error <= max/4094 per sample). 'float16' costs ~5e-4
+    relative per sample; 'float32' is exact (gather-path default).
+    Override with RIPTIDE_WIRE_DTYPE=float32|float16|uint12|uint8.
     """
     mode = os.environ.get("RIPTIDE_WIRE_DTYPE")
     if mode:
-        mode = {"u12": "uint12"}.get(mode, mode)
-        if mode not in ("float32", "float16", "uint12"):
+        mode = {"u12": "uint12", "u8": "uint8"}.get(mode, mode)
+        if mode not in ("float32", "float16", "uint12", "uint8"):
             raise ValueError(f"unsupported RIPTIDE_WIRE_DTYPE={mode!r}")
         return mode
-    return "uint12" if path == "kernel" else "float32"
+    return "uint8" if path == "kernel" else "float32"
+
+
+# Quantisation block of the uint8 wire: one float32 scale per BLKQ
+# samples (scale overhead 4/256 bytes/sample).
+BLKQ = 256
 
 
 def _wire_layout(plan, mode):
     """Per-stage (offsets, lengths, total) of the flat wire buffer, in
     the mode's storage unit: BYTES for 'uint12' (each stage 3 bytes per
-    sample pair, odd sample counts padded by one), ELEMENTS otherwise."""
+    sample pair, odd sample counts padded by one) and 'uint8' (one byte
+    per sample, stages padded to whole BLKQ blocks), ELEMENTS
+    otherwise."""
     if mode == "uint12":
         lens = [3 * ((st.n + 1) // 2) for st in plan.stages]
+    elif mode == "uint8":
+        lens = [BLKQ * (-(-st.n // BLKQ)) for st in plan.stages]
     else:
         lens = [st.n for st in plan.stages]
     offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
     return offs[:-1], lens, int(offs[-1])
+
+
+def _scale_layout(plan):
+    """uint8 wire: per-stage offsets into the flat (D, total_blocks)
+    block-scale array."""
+    nblks = [-(-st.n // BLKQ) for st in plan.stages]
+    soffs = np.concatenate([[0], np.cumsum(nblks)]).astype(np.int64)
+    return soffs[:-1], nblks, int(soffs[-1])
 
 
 def _u12_decode(seg, scale):
@@ -229,7 +250,7 @@ def _u12_decode(seg, scale):
     return (q.astype(jnp.float32) - 2048.0) * scale[..., None]
 
 
-@partial(jax.jit, static_argnames=("off", "nb", "n", "shapes", "rows", "P"))
+@cached_jit(static_argnames=("off", "nb", "n", "shapes", "rows", "P"))
 def _pack_static_u12(flat, scale, off, nb, n, shapes, rows, P):
     """uint12 counterpart of :func:`_pack_static`: slice nb wire bytes,
     decode to float32 with the stage's per-trial scales, then the same
@@ -244,13 +265,86 @@ def _pack_static_u12(flat, scale, off, nb, n, shapes, rows, P):
     return jnp.stack(outs, axis=-3)
 
 
-@partial(jax.jit, static_argnames=("off", "nb", "n", "nout"))
+@cached_jit(static_argnames=("off", "nb", "n", "nout"))
 def _unpack_u12_padded(flat, scale, off, nb, n, nout):
     """Gather-path uint12 unpack: decode one stage's samples and
     zero-pad to the plan-wide padded length."""
     seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
     xd = _u12_decode(seg, scale)[..., :n]
     return jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
+
+
+def _u8_decode(seg, scaleseg):
+    """(..., nblk * BLKQ) uint8 wire bytes + (..., nblk) block scales ->
+    (..., nblk * BLKQ) float32 samples."""
+    lead = seg.shape[:-1]
+    nblk = seg.shape[-1] // BLKQ
+    q = seg.reshape(lead + (nblk, BLKQ)).astype(jnp.float32) - 128.0
+    return (q * scaleseg[..., None]).reshape(lead + (nblk * BLKQ,))
+
+
+@cached_jit(static_argnames=("off", "nb", "soff", "nblk", "n", "shapes",
+                             "rows", "P"))
+def _pack_static_u8(flat, scales, off, nb, soff, nblk, n, shapes, rows, P):
+    """uint8 counterpart of :func:`_pack_static`: slice nb wire bytes
+    and the stage's block scales, decode, then the per-problem reshape +
+    zero-pad. One dispatch per stage."""
+    seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
+    sc = jax.lax.slice_in_dim(scales, soff, soff + nblk, axis=-1)
+    xd = _u8_decode(seg, sc)[..., :n]
+    outs = []
+    for m, p in shapes:
+        sub = xd[..., : m * p].reshape(xd.shape[:-1] + (m, p))
+        pad = [(0, 0)] * (sub.ndim - 2) + [(0, rows - m), (0, P - p)]
+        outs.append(jnp.pad(sub, pad))
+    return jnp.stack(outs, axis=-3)
+
+
+@cached_jit(static_argnames=("off", "nb", "soff", "nblk", "n", "nout"))
+def _unpack_u8_padded(flat, scales, off, nb, soff, nblk, n, nout):
+    """Gather-path uint8 unpack: decode one stage's samples and
+    zero-pad to the plan-wide padded length."""
+    seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
+    sc = jax.lax.slice_in_dim(scales, soff, soff + nblk, axis=-1)
+    xd = _u8_decode(seg, sc)[..., :n]
+    return jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
+
+
+def _prepare_u8(plan, batch):
+    """8-bit block-adaptive wire preparation: native single-pass when
+    available, vectorised numpy otherwise. Returns
+    (wire (D, totbytes) uint8, scales (D, total_blocks) float32)."""
+    from .. import native
+
+    offs, lens, tot = _wire_layout(plan, "uint8")
+    soffs, nblks, stot = _scale_layout(plan)
+    if native.available():
+        imin, imax, wmin, wmax, wint = _ds_pack(plan)
+        nouts = np.asarray([st.n for st in plan.stages], np.int32)
+        return native.prepare_wire_u8(
+            batch, imin, imax, wmin, wmax, wint, nouts, offs, tot,
+            soffs, stot, blkq=BLKQ,
+        )
+    d64, cs = _prefix64(batch)
+    D = batch.shape[0]
+    out = np.zeros((D, tot), np.uint8)
+    scales = np.empty((D, stot), np.float32)
+    for i, st in enumerate(plan.stages):
+        xd = _stage_downsample(st, d64, cs)[..., : st.n]
+        nblk = nblks[i]
+        pad = nblk * BLKQ - st.n
+        if pad:
+            xd = np.concatenate([xd, np.zeros((D, pad), np.float32)], axis=1)
+        blocks = xd.reshape(D, nblk, BLKQ)
+        bmax = np.abs(blocks).max(axis=2)
+        s = np.where(bmax > 0, bmax / 127.0, 1.0).astype(np.float32)
+        scales[:, soffs[i] : soffs[i] + nblk] = s
+        inv = (np.float32(1.0) / s).astype(np.float32)
+        q = np.rint(blocks * inv[:, :, None]).astype(np.int32) + 128
+        out[:, offs[i] : offs[i] + lens[i]] = (
+            (q & 255).astype(np.uint8).reshape(D, lens[i])
+        )
+    return out, scales
 
 
 def _prepare_u12(plan, batch):
@@ -350,7 +444,12 @@ def _run_stage_kernel(st, flat_dev, off, plan, meta, i):
     interpret = jax.default_backend() == "cpu"
     kern = st.cycle_kernel(interpret=interpret)
     shapes = tuple(zip(st.ms_padded, st.ps_padded))
-    if meta["mode"] == "uint12":
+    if meta["mode"] == "uint8":
+        soffs, nblks = meta["soffs"], meta["nblks"]
+        x = _pack_static_u8(flat_dev, meta["scales_dev"], off,
+                            meta["lens"][i], int(soffs[i]), nblks[i],
+                            st.n, shapes, kern.rows, kern.P)
+    elif meta["mode"] == "uint12":
         x = _pack_static_u12(flat_dev, meta["scales_dev"][i], off,
                              meta["lens"][i], st.n, shapes,
                              kern.rows, kern.P)
@@ -411,7 +510,7 @@ def _assemble(plan, raw_per_stage):
     return np.empty((0, nw), np.float32)
 
 
-@partial(jax.jit, static_argnames=("plan",))
+@cached_jit(static_argnames=("plan",))
 def _assemble_device(plan, *outs):
     """Device-side counterpart of :func:`_assemble`: slice every stage's
     evaluated rows and concatenate in plan trial order, keeping the
@@ -431,7 +530,7 @@ def prepare_stage_data(plan, batch, mode=None):
     """
     HOST half of a batched search: every cascade stage's downsampling of
     the (D, N) batch, concatenated unpadded into ONE flat wire buffer in
-    the transport of :func:`_wire_mode` (12-bit packed by default on the
+    the transport of :func:`_wire_mode` (8-bit block-scaled by default on the
     kernel path). Ships to the device as a single transfer — per-stage
     transfers each pay the interconnect round-trip latency. Runs in the
     native threaded runtime when available; callers can invoke this on a
@@ -439,7 +538,7 @@ def prepare_stage_data(plan, batch, mode=None):
     execution of the current one (ctypes releases the GIL).
 
     Returns ``(flat, meta)`` where meta carries the path, wire mode,
-    per-stage offsets/lengths and (uint12) quantisation scales.
+    per-stage offsets/lengths and (uint8/uint12) quantisation scales.
     """
     batch = np.asarray(batch, dtype=np.float32)
     if batch.ndim != 2 or batch.shape[1] != plan.size:
@@ -448,7 +547,9 @@ def prepare_stage_data(plan, batch, mode=None):
     mode = mode or _wire_mode(path)
     offs, lens, tot = _wire_layout(plan, mode)
     scales = None
-    if mode == "uint12":
+    if mode == "uint8":
+        flat, scales = _prepare_u8(plan, batch)
+    elif mode == "uint12":
         flat, scales = _prepare_u12(plan, batch)
     else:
         wire = np.dtype(mode)
@@ -485,6 +586,9 @@ def ship_stage_data(plan, prepared):
     meta = dict(meta)
     if meta["scales"] is not None:
         meta["scales_dev"] = jnp.asarray(meta["scales"])
+    if meta["mode"] == "uint8":
+        soffs, nblks, _ = _scale_layout(plan)
+        meta["soffs"], meta["nblks"] = soffs, nblks
     return parts, part_of, meta
 
 
@@ -505,6 +609,11 @@ def _queue_stages(plan, batch, prepared=None, shipped=None):
         c, off = part_of[i]
         if path == "kernel" and _kernel_eligible(st, plan):
             outs.append(_run_stage_kernel(st, parts[c], off, plan, meta, i))
+        elif mode == "uint8":
+            xd = _unpack_u8_padded(parts[c], meta["scales_dev"], off,
+                                   meta["lens"][i], int(meta["soffs"][i]),
+                                   meta["nblks"][i], st.n, plan.nout)
+            outs.append(_run_stage_gather(st, xd, plan))
         elif mode == "uint12":
             xd = _unpack_u12_padded(parts[c], meta["scales_dev"][i], off,
                                     meta["lens"][i], st.n, plan.nout)
@@ -546,6 +655,12 @@ def collect_search_batch(handle, dms):
     return collect_peaks(pp, peaks_handle, dms)
 
 
+def search_snr_dev(handle):
+    """The queued batch's device-resident (D, trials, NW) S/N cube.
+    Valid until :func:`collect_search_batch` releases it."""
+    return handle[1][1]
+
+
 def run_search_batch(plan, batch, tobs, dms=None, prepared=None,
                      shipped=None, **peak_kwargs):
     """
@@ -563,7 +678,7 @@ def run_search_batch(plan, batch, tobs, dms=None, prepared=None,
                                 shipped=shipped, **peak_kwargs)
     if dms is None:
         if D is None:
-            D = handle[1][1].shape[0]
+            D = search_snr_dev(handle).shape[0]
         dms = np.zeros(D)
     return collect_search_batch(handle, dms)
 

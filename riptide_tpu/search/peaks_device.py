@@ -38,6 +38,7 @@ import numpy as np
 from functools import partial
 
 from ..clustering import cluster1d
+from ..utils.exec_cache import cached_jit
 from ..peak_detection import Peak, fit_threshold
 
 log = logging.getLogger("riptide_tpu.peaks_device")
@@ -84,6 +85,11 @@ class PeakPlan:
         # float64 np.polyfit re-fit happens on host in _finalize.
         V = np.vander(np.log(self.fc), self.polydeg + 1)
         self.fitmat = (np.linalg.inv(V.T @ V) @ V.T).astype(np.float32)
+        # Stable identity for the cross-process executable cache.
+        self.cache_token = ("peak_plan", getattr(plan, "cache_token", None),
+                            self.tobs, self.smin, self.nstd, self.minseg,
+                            self.polydeg, self.clrad, nseg, pts,
+                            self.BLK, self.CAP)
 
     # -- step 1: device segment stats ------------------------------------
 
@@ -94,7 +100,7 @@ class PeakPlan:
         q = jnp.percentile(seg, jnp.asarray([25.0, 50.0, 75.0]), axis=-1)
         return q.transpose(1, 2, 3, 0)  # (D, NW, nseg, 3)
 
-    @partial(jax.jit, static_argnames=("self",))
+    @cached_jit(static_argnames=("self",))
     def _stats(self, snr):
         """snr: (D, n, NW) f32 -> (D, NW, nseg, 3) [p25, p50, p75]."""
         return self._stats_impl(snr)
@@ -132,7 +138,12 @@ class PeakPlan:
     # either costs seconds per batch at this width).
 
     BLK = 512
-    CAP = 16  # non-empty blocks gathered on device per (trial, width)
+    # Non-empty blocks compacted on device per (trial, width) column:
+    # real searches select a few clustered blocks per column, so 8 is
+    # ample headroom while keeping the single pull ~5 MB at D=32; the
+    # overflow fallback (extra round-trip gather) covers pathological
+    # thresholds.
+    CAP = 8
 
     @property
     def _nb(self):
@@ -151,7 +162,7 @@ class PeakPlan:
         mask = jnp.pad(mask, [(0, 0), (0, 0), (0, pad)])
         return mask.reshape(D, NW, self._nb, self.BLK).sum(-1).astype(jnp.int32)
 
-    @partial(jax.jit, static_argnames=("self",))
+    @cached_jit(static_argnames=("self",))
     def _block_counts(self, snr, polyco):
         """snr (D, n, NW), polyco (D, NW, deg+1) f32 ->
         cnt (D, NW, nb) int32 of threshold-selected points per block."""
@@ -159,7 +170,7 @@ class PeakPlan:
 
     # -- fused single-pull program ---------------------------------------
 
-    @partial(jax.jit, static_argnames=("self",))
+    @cached_jit(static_argnames=("self",))
     def _fused(self, snr):
         """The whole device side in one program: stats, f32 threshold
         fit, block counts, and compaction of the first CAP non-empty
@@ -208,7 +219,7 @@ class PeakPlan:
         vals = buf[offs[3]:offs[4]].reshape(D, NW, CAP, BLK)
         return stats, cnt, ids, vals
 
-    @partial(jax.jit, static_argnames=("self",))
+    @cached_jit(static_argnames=("self",))
     def _gather_blocks(self, snr, flat_ids):
         """Gather the (d, iw, block) rows of BLK S/N values named by
         flat_ids ((k,) int32 = (d * NW + iw) * nb + b); the compiled

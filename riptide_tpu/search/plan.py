@@ -204,6 +204,13 @@ class PeriodogramPlan:
             if st.active and st.length > 0:
                 self.stages.append(st)
 
+        # Stable identity for the cross-process executable cache
+        # (riptide_tpu.utils.exec_cache): everything a compiled program
+        # specialised on this plan can depend on.
+        self.cache_token = ("pgram_plan", self.size, self.tsamp, widths,
+                            self.period_min, self.period_max,
+                            self.bins_min, self.bins_max)
+
         self.length = sum(st.length for st in self.stages)
         # Assembled float64 periods / uint32 foldbins, fixed at plan time.
         self.all_periods = (

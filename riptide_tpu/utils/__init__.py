@@ -1,0 +1,2 @@
+"""Shared utilities: coordinate transforms (astropy-free SkyCoord
+equivalent) and the cross-process executable cache."""

@@ -1,0 +1,175 @@
+"""
+Cross-process executable cache for jitted XLA programs.
+
+The TPU backend in this environment does not populate JAX's persistent
+compilation cache, so every fresh process re-pays the remote XLA compile
+of every engine program (~15 s each through a tunneled compiler — the
+dominant cost of process startup). Compiled executables DO round-trip
+through ``jax.experimental.serialize_executable`` here, so this module
+wraps ``jax.jit`` with a disk cache of serialized executables:
+
+* key = package source hash + jax version + device kind + program name
+  + per-argument signature (array shape/dtype; ``repr`` for statics;
+  an object's ``cache_token`` attribute when present — plans define one);
+* on miss: AOT ``lower(...).compile()``, serialize, store atomically;
+* off-TPU (the CPU test suite) or on any failure: plain jit.
+
+The whole-package source hash is deliberately coarse: any source edit
+invalidates every cached engine program (correctness over warm starts).
+The Pallas cycle kernel keeps its own narrower cache in ops/ffa_kernel.
+"""
+import functools
+import hashlib
+import logging
+import os
+import pickle
+import tempfile
+import threading
+
+import jax
+
+log = logging.getLogger("riptide_tpu.exec_cache")
+
+__all__ = ["cached_jit", "load_or_compile_exec"]
+
+_DIR = os.environ.get(
+    "RIPTIDE_EXEC_CACHE",
+    os.path.join(tempfile.gettempdir(),
+                 f"riptide_tpu_exec_cache_{os.getuid()}"),
+)
+
+_lock = threading.Lock()
+_src_hash_memo = None
+
+
+def _src_hash():
+    global _src_hash_memo
+    if _src_hash_memo is None:
+        h = hashlib.sha1()
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for root, dirs, files in os.walk(pkg):
+            dirs.sort()
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    with open(os.path.join(root, f), "rb") as fh:
+                        h.update(fh.read())
+        h.update(jax.__version__.encode())
+        _src_hash_memo = h.hexdigest()
+    return _src_hash_memo
+
+
+def load_or_compile_exec(path, jitted, args, kw=None, name="program"):
+    """Deserialize a compiled executable from ``path``, or AOT-compile
+    ``jitted`` at ``args``/``kw`` and store it there (atomic write,
+    0700 parent dir). Returns a compiled callable taking only the ARRAY
+    arguments (statics are baked in by ``lower``). Shared by the
+    generic :func:`cached_jit` wrapper and the Pallas cycle-kernel cache
+    (ops/ffa_kernel.py), which keys its entries more narrowly."""
+    from jax.experimental import serialize_executable as se
+
+    if os.path.exists(path):
+        try:
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+            return se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception as err:
+            log.warning("exec cache load failed for %s (%s); recompiling",
+                        name, err)
+    compiled = jitted.lower(*args, **(kw or {})).compile()
+    try:
+        d = os.path.dirname(path)
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        payload = se.serialize(compiled)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(payload, f)
+        os.replace(tmp, path)
+    except Exception as err:
+        log.warning("exec cache store failed for %s (%s)", name, err)
+    return compiled
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except RuntimeError:
+        return False
+
+
+def _is_array(a):
+    # numpy scalars (np.int64 etc.) have shape/dtype but carry VALUE
+    # semantics a compiled executable bakes in — treat them as statics
+    # so the cache key includes the value.
+    import numpy as _np
+
+    if isinstance(a, _np.generic):
+        return False
+    return hasattr(a, "shape") and hasattr(a, "dtype")
+
+
+class _Cached:
+    def __init__(self, jitted, name):
+        self.jitted = jitted
+        self.name = name
+        self._mem = {}
+
+    def _key(self, flat_args):
+        parts = [self.name, _src_hash(), jax.devices()[0].platform,
+                 getattr(jax.devices()[0], "device_kind", "")]
+        for a in flat_args:
+            tok = getattr(a, "cache_token", None)
+            if tok is not None:
+                parts.append(("t", tok))
+            elif _is_array(a):
+                parts.append(("a", tuple(a.shape), str(a.dtype)))
+            else:
+                parts.append(("s", repr(a)))
+        return hashlib.sha1(repr(parts).encode()).hexdigest()
+
+    def _load_or_compile(self, key, args, kw):
+        return load_or_compile_exec(os.path.join(_DIR, key + ".pkl"),
+                                    self.jitted, args, kw, name=self.name)
+
+    def __get__(self, obj, objtype=None):
+        # Descriptor protocol so the wrapper also works on methods
+        # (static self carries the instance's cache_token).
+        if obj is None:
+            return self
+        return functools.partial(self.__call__, obj)
+
+    def __call__(self, *args, **kw):
+        if not _on_tpu() or os.environ.get("RIPTIDE_EXEC_CACHE") == "off":
+            return self.jitted(*args, **kw)
+        flat = list(args) + [kw[k] for k in sorted(kw)]
+        key = self._key(flat)
+        fn = self._mem.get(key)
+        if fn is None:
+            with _lock:
+                fn = self._mem.get(key)
+                if fn is None:
+                    try:
+                        fn = self._load_or_compile(key, args, kw)
+                    except Exception as err:
+                        log.warning("exec cache disabled for %s (%s)",
+                                    self.name, err)
+                        fn = self.jitted
+                    self._mem[key] = fn
+        if fn is self.jitted:
+            return fn(*args, **kw)
+        # AOT executables take only the ARRAY arguments; statics were
+        # baked in at lower() time.
+        darr = [a for a in flat
+                if _is_array(a) and getattr(a, "cache_token", None) is None]
+        return fn(*darr)
+
+
+def cached_jit(fun=None, *, static_argnames=()):
+    """``jax.jit`` with the cross-process executable cache. Supports the
+    decorator forms ``@cached_jit`` and
+    ``@cached_jit(static_argnames=...)``. Static args must be
+    non-arrays (or carry a stable ``cache_token``)."""
+    if fun is None:
+        return functools.partial(cached_jit, static_argnames=static_argnames)
+    jitted = jax.jit(fun, static_argnames=static_argnames)
+    wrapper = _Cached(jitted, getattr(fun, "__qualname__", repr(fun)))
+    return functools.wraps(fun)(wrapper)

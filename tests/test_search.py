@@ -114,10 +114,11 @@ def test_snr_parity_oracle():
     assert abs(best_snr - 18.5) < 0.15
 
 
-@pytest.mark.parametrize("wire", ["float16", "uint12"])
+@pytest.mark.parametrize("wire", ["float16", "uint12", "uint8"])
 def test_snr_parity_oracle_lossy_wire(monkeypatch, wire):
     """The lossy host->device wire transports (float16, and the 12-bit
-    packed default of the TPU kernel path — search/engine.py:_wire_mode)
+    12-bit packed option, and the 8-bit block-scaled default of the TPU
+    kernel path — search/engine.py:_wire_mode)
     must hold the same 18.5 +/- 0.15 oracle bar: float16's ~5e-4
     relative rounding and uint12's max/4094 quantisation step are both
     S/N errors of order 0.01. Exercised through the CPU gather path,
