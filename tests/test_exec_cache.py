@@ -1,0 +1,84 @@
+"""
+Cross-process executable cache (riptide_tpu/utils/exec_cache.py).
+
+The real payoff needs the TPU backend (where JAX's persistent
+compilation cache is unavailable); these tests exercise the wrapper's
+correctness-critical plumbing on CPU: passthrough off-TPU, key
+construction (numpy scalars keyed by VALUE, arrays by shape/dtype,
+``cache_token`` objects by token), and the AOT load-or-compile path
+with the backend check monkeypatched.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from riptide_tpu.utils import exec_cache
+from riptide_tpu.utils.exec_cache import cached_jit
+
+
+def test_passthrough_off_tpu():
+    @cached_jit(static_argnames=("k",))
+    def f(x, k):
+        return x * k
+
+    out = f(jnp.arange(4.0), k=3)
+    np.testing.assert_allclose(np.asarray(out), [0, 3, 6, 9])
+
+
+def test_key_distinguishes_numpy_scalar_values():
+    @cached_jit(static_argnames=("off",))
+    def f(x, off):
+        return x + off
+
+    # np.int64 statics must key by VALUE (an AOT executable bakes the
+    # static in); arrays key by shape/dtype only.
+    k1 = f._key([jnp.zeros(4), np.int64(0)])
+    k2 = f._key([jnp.zeros(4), np.int64(4096)])
+    assert k1 != k2
+    k3 = f._key([jnp.ones(4), np.int64(0)])
+    assert k1 == k3  # same shapes/dtypes, same statics
+
+    class Tok:
+        cache_token = ("plan", 1)
+
+    class Tok2:
+        cache_token = ("plan", 2)
+
+    assert f._key([Tok()]) == f._key([Tok()])
+    assert f._key([Tok()]) != f._key([Tok2()])
+
+
+def test_aot_path_on_forced_backend(monkeypatch, tmp_path):
+    """With the backend check forced on, the wrapper AOT-compiles,
+    memoizes per signature, and still returns correct results for both
+    signatures (statics baked per executable)."""
+    monkeypatch.setattr(exec_cache, "_on_tpu", lambda: True)
+    monkeypatch.setattr(exec_cache, "_DIR", str(tmp_path))
+
+    calls = []
+
+    @cached_jit(static_argnames=("off",))
+    def f(x, off):
+        calls.append(off)
+        return x + off
+
+    x = jnp.arange(4.0)
+    np.testing.assert_allclose(np.asarray(f(x, 1)), [1, 2, 3, 4])
+    np.testing.assert_allclose(np.asarray(f(x, 10)), [10, 11, 12, 13])
+    # repeat: memoized executables, no retrace
+    n = len(calls)
+    np.testing.assert_allclose(np.asarray(f(x, 1)), [1, 2, 3, 4])
+    assert len(calls) == n
+
+
+def test_off_switch(monkeypatch):
+    monkeypatch.setattr(exec_cache, "_on_tpu", lambda: True)
+    monkeypatch.setenv("RIPTIDE_EXEC_CACHE", "off")
+
+    @cached_jit
+    def f(x):
+        return x * 2
+
+    np.testing.assert_allclose(np.asarray(f(jnp.arange(3.0))), [0, 2, 4])
+    assert not f._mem  # bypassed entirely
