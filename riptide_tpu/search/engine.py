@@ -696,10 +696,12 @@ def run_periodogram(plan, data):
     if data.size != plan.size:
         raise ValueError("data length does not match plan size")
     outs = _queue_stages(plan, data[None])
-    # One host sync at the end: device work for all cycles is queued
-    # asynchronously, then gathered.
-    raw = [np.asarray(o)[0] for o in outs]
-    snrs = _assemble(plan, raw)
+    # Device-side assembly, then ONE device->host pull: per-stage pulls
+    # each pay the interconnect round trip (~0.1-0.4 s through a
+    # tunneled device x 22 stages dominated single-series latency).
+    snrs = np.ascontiguousarray(
+        np.asarray(_assemble_device(plan, *outs)[0]), dtype=np.float32
+    )
     return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs
 
 
@@ -756,9 +758,8 @@ def run_periodogram_batch(plan, batch):
     # the NEXT batch while this one computes (see pipeline.batcher and
     # bench.py).
     outs = _queue_stages(plan, batch)
-    D = np.asarray(batch).shape[0]
-    raw = [np.asarray(o) for o in outs]  # (D, B, rows<=R, NW) each
-    snrs = np.stack(
-        [_assemble(plan, [r[d] for r in raw]) for d in range(D)]
+    # Device-side assembly + one pull (see run_periodogram).
+    snrs = np.ascontiguousarray(
+        np.asarray(_assemble_device(plan, *outs)), dtype=np.float32
     )
     return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs
