@@ -447,6 +447,70 @@ static void stage_values(const float* x, const double* c, const int32_t* a,
     stage_values_scalar(x, c, a, b, w0, w1, wi, out, n, vmax_io);
 }
 
+// One trial's float64 prefix sum in the 4-lane vector-scan order
+// shared bit-for-bit with the numpy fallback (search/engine.py
+// `_prefix64`): elements are processed in groups of 4 with lane sums
+//   l = [x0, x1+x0, (x2+x1)+x0, (x3+x2)+(x1+x0)]
+// then cs[4v+1..4v+4] = carry + l and carry = cs[4v+4]; the <4-element
+// tail continues serially from carry. A strictly serial accumulator is
+// latency-bound (one dependent f64 add per element); this order's
+// serial chain is one add per FOUR elements, the rest is lane-parallel
+// (and AVX2-vectorized below), for ~4x on the survey's host hot path.
+// The association change is ~1 ulp in float64 — far below the wire
+// quantisation — but both implementations must share it exactly so the
+// native-vs-numpy byte-parity tests stay deterministic.
+#if defined(__x86_64__)
+__attribute__((target("avx2")))
+static double prefix_scan4_avx2(const float* x, int64_t nv, double* c) {
+    const __m256d zero = _mm256_setzero_pd();
+    __m256d vcarry = _mm256_setzero_pd();
+    for (int64_t v = 0; v < nv; ++v) {
+        const int64_t i = 4 * v;
+        __m256d xv = _mm256_cvtps_pd(_mm_loadu_ps(x + i));
+        // s1 = xv + [0, x0, x1, x2]
+        __m256d sh1 = _mm256_permute4x64_pd(xv, _MM_SHUFFLE(2, 1, 0, 0));
+        sh1 = _mm256_blend_pd(sh1, zero, 0x1);
+        __m256d s1 = _mm256_add_pd(xv, sh1);
+        // s2 = s1 + [0, 0, s1_0, s1_1]
+        __m256d sh2 = _mm256_permute4x64_pd(s1, _MM_SHUFFLE(1, 0, 0, 0));
+        sh2 = _mm256_blend_pd(sh2, zero, 0x3);
+        __m256d s2 = _mm256_add_pd(s1, sh2);
+        __m256d out = _mm256_add_pd(s2, vcarry);
+        _mm256_storeu_pd(c + i + 1, out);
+        // carry = out lane 3, broadcast
+        vcarry = _mm256_permute4x64_pd(out, _MM_SHUFFLE(3, 3, 3, 3));
+    }
+    return _mm256_cvtsd_f64(vcarry);
+}
+#endif
+
+static void prefix_scan4(const float* x, int64_t N, double* c) {
+    c[0] = 0.0;
+    double carry = 0.0;
+    const int64_t nv = N / 4;
+    int64_t i = 4 * nv;
+#if defined(__x86_64__)
+    if (avx2_supported()) {
+        carry = prefix_scan4_avx2(x, nv, c);
+    } else
+#endif
+    {
+        for (int64_t v = 0; v < nv; ++v) {
+            const int64_t j = 4 * v;
+            const double x0 = x[j], x1 = x[j + 1], x2 = x[j + 2], x3 = x[j + 3];
+            const double l1 = x1 + x0;
+            const double l2 = (x2 + x1) + x0;
+            const double l3 = (x3 + x2) + l1;
+            c[j + 1] = carry + x0;
+            c[j + 2] = carry + l1;
+            c[j + 3] = carry + l2;
+            c[j + 4] = carry + l3;
+            carry = c[j + 4];
+        }
+    }
+    for (; i < N; ++i) { carry += x[i]; c[i + 1] = carry; }
+}
+
 // Per-trial float64 prefix sums of a (D, N) batch, threaded over trials
 // (shared by the wire-preparation entry points).
 static void batch_prefix_sums(const float* batch, int64_t D, int64_t N,
@@ -457,11 +521,7 @@ static void batch_prefix_sums(const float* batch, int64_t D, int64_t N,
         pool.emplace_back([&]() {
             int64_t d;
             while ((d = next_d.fetch_add(1)) < D) {
-                const float* x = batch + d * N;
-                double* c = cs + d * (N + 1);
-                double acc = 0.0;
-                c[0] = 0.0;
-                for (int64_t i = 0; i < N; ++i) { acc += x[i]; c[i + 1] = acc; }
+                prefix_scan4(batch + d * N, N, cs + d * (N + 1));
             }
         });
     }

@@ -93,23 +93,30 @@ class BatchSearcher:
         Returns a flat list of Peaks."""
         chunks = [list(c) for c in fname_chunks]
         peaks = []
-        # Two pools: `stager` runs the one-per-chunk prepare task, and
-        # `loaders` parallelises the file loads INSIDE it. (One shared
-        # pool would deadlock at io_threads=1: the staging task would
-        # occupy the only worker while waiting on its own load futures.)
+        # Three pools: `stager` runs the one-per-chunk CPU-bound prepare
+        # task (load + detrend + wire preparation), `shipper` runs the
+        # wire-bound device transfer of the prepared chunk, and
+        # `loaders` parallelises the file loads INSIDE the staging task.
+        # (One shared pool would deadlock at io_threads=1: the staging
+        # task would occupy the only worker while waiting on its own
+        # load futures.) Dedicated prep/ship threads mean the steady
+        # state is max(host prep, wire, device) rather than their sum.
         with ThreadPoolExecutor(max_workers=1) as stager, \
+                ThreadPoolExecutor(max_workers=1) as shipper, \
                 ThreadPoolExecutor(max_workers=self.io_threads) as loaders:
 
             def stage_chunk(fnames):
                 tslist = list(loaders.map(self.load_prepared, fnames))
-                return self._prepare_chunk(tslist)
+                items = self._prepare_chunk(tslist)
+                return shipper.submit(self._ship_chunk, items)
 
             pending = stager.submit(stage_chunk, chunks[0]) if chunks else None
             queued = None
             for i, chunk in enumerate(chunks):
-                items = pending.result()
+                ship_fut = pending.result()   # prep done, ship submitted
                 if i + 1 < len(chunks):
                     pending = stager.submit(stage_chunk, chunks[i + 1])
+                items = ship_fut.result()     # wire transfer enqueued
                 # Queue chunk i's device work BEFORE collecting chunk
                 # i-1: the device stays busy while the host pays the
                 # previous chunk's result round trip.
@@ -172,16 +179,27 @@ class BatchSearcher:
                 items.append((members, batch, conf, plan, prepared))
         return items
 
+    def _ship_chunk(self, items):
+        """Wire half of one chunk (runs on the dedicated ship thread):
+        start every prepared work item's host->device transfer."""
+        from ..search.engine import ship_stage_data
+
+        return [
+            (members, batch, conf, plan,
+             None if prepared is None else ship_stage_data(plan, prepared))
+            for members, batch, conf, plan, prepared in items
+        ]
+
     def _queue_chunk(self, items):
         return [
-            self._queue_range(conf, members, batch, plan, prepared)
-            for members, batch, conf, plan, prepared in items
+            self._queue_range(conf, members, batch, plan, shipped)
+            for members, batch, conf, plan, shipped in items
         ]
 
     def _collect_chunk(self, queued):
         return [p for collect in queued for p in collect()]
 
-    def _queue_range(self, conf, members, batch, plan, prepared=None):
+    def _queue_range(self, conf, members, batch, plan, shipped=None):
         """Enqueue one (search range x chunk) device program; returns a
         zero-argument collector producing the chunk's Peak list."""
         dms = [float(ts.metadata["dm"] or 0.0) for ts in members]
@@ -201,7 +219,7 @@ class BatchSearcher:
                 p for d in range(nreal) for p in peaks_per_trial[d]
             ]
         handle = queue_search_batch(
-            plan, batch, tobs=tobs, prepared=prepared, **fp_kwargs
+            plan, batch, tobs=tobs, shipped=shipped, **fp_kwargs
         )
 
         def collect():

@@ -115,9 +115,35 @@ def _stage_downsample(st, d64, cs):
 
 
 def _prefix64(data):
+    """Float64 prefix sums in the 4-lane vector-scan order of the native
+    runtime's ``prefix_scan4`` (riptide_native.cpp): per group of 4,
+    lane sums l = [x0, x1+x0, (x2+x1)+x0, (x3+x2)+(x1+x0)], then
+    cs[4v+1..4v+4] = carry_v + l with carry_{v+1} = carry_v + l[3], and
+    a serial tail. Bit-identical to the native path by construction
+    (IEEE addition is commutative; only the association matters), which
+    the wire byte-parity tests rely on."""
     data = np.asarray(data, dtype=np.float64)
-    cs = np.zeros(data.shape[:-1] + (data.shape[-1] + 1,), np.float64)
-    np.cumsum(data, axis=-1, out=cs[..., 1:])
+    n = data.shape[-1]
+    lead = data.shape[:-1]
+    cs = np.zeros(lead + (n + 1,), np.float64)
+    nv = n // 4
+    if nv:
+        xv = data[..., : 4 * nv].reshape(lead + (nv, 4))
+        s1 = xv.copy()
+        s1[..., 1:] += xv[..., :-1]
+        # In-place: reads lanes 0-1, writes lanes 2-3 (disjoint).
+        s2 = s1
+        s2[..., 2:] += s1[..., :-2]
+        carry = np.zeros(lead + (nv,), np.float64)
+        np.cumsum(s2[..., :-1, 3], axis=-1, out=carry[..., 1:])
+        cs[..., 1 : 4 * nv + 1] = (s2 + carry[..., None]).reshape(
+            lead + (4 * nv,)
+        )
+    if n > 4 * nv:
+        tail = np.concatenate(
+            [cs[..., 4 * nv : 4 * nv + 1], data[..., 4 * nv :]], axis=-1
+        )
+        cs[..., 4 * nv :] = np.cumsum(tail, axis=-1)
     return data, cs
 
 

@@ -135,6 +135,26 @@ def test_downsample_stages_matches_numpy():
     np.testing.assert_array_equal(got16, want.astype(np.float16))
 
 
+def test_downsample_stages_matches_numpy_ragged_n():
+    """N % 4 != 0 exercises prefix_scan4's serial tail and the
+    vector-to-tail carry handoff; native and numpy must still agree
+    byte-for-byte."""
+    from riptide_tpu.search.engine import (
+        _ds_pack, _prefix64, _stage_downsample,
+    )
+    from riptide_tpu.search.plan import periodogram_plan
+
+    n = (1 << 16) + 3
+    plan = periodogram_plan(n, 1e-3, (1, 2, 3), 64e-3, 2.0, 64, 71)
+    batch = rng.standard_normal((2, n)).astype(np.float32)
+    d64, cs = _prefix64(batch)
+    want = np.stack([_stage_downsample(st, d64, cs) for st in plan.stages])
+    imin, imax, wmin, wmax, wint = _ds_pack(plan)
+    got = native.downsample_stages(batch, imin, imax, wmin, wmax, wint,
+                                   dtype=np.float32)
+    np.testing.assert_array_equal(got, want)
+
+
 def test_downsample_stages_f16_conversion_edges():
     """The float16 wire conversion must be IEEE round-to-nearest-even for
     every regime numpy handles: normals, subnormals, overflow->inf, and

@@ -1,0 +1,141 @@
+"""Survey phase timing: where does a pipelined chunk's wall time go?
+
+Replicates bench.py's timed pipeline at the headline shape but records,
+per iteration, the MAIN-THREAD blocking time of each phase:
+
+  prep    host wire preparation (runs on the worker thread; reported
+          as its own wall time, not main-thread time)
+  ship    ship_stage_data call (device_put of the wire buffer): if the
+          tunnel's transfer API blocks, this shows the full wire time
+  queue   queue_search_batch (dispatch enqueue of ~45 device programs)
+  collect collect_search_batch (sync: waits for the device + one pull)
+
+Also runs two microbenches first:
+  wire    raw device_put of a wire-sized buffer, 3x (today's tunnel rate)
+  rtt     tiny device_put + pull roundtrip, 5x (today's tunnel latency)
+
+Usage: python tools/stime.py [D] [CHUNKS]
+"""
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/riptide_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+N = 1 << 23
+TSAMP = 64e-6
+PKW = dict(smin=7.0, segwidth=5.0, nstd=6.0, minseg=10, polydeg=2, clrad=0.1)
+
+
+def main(D=32, CHUNKS=4):
+    from bench import _make_batch
+    from riptide_tpu.ffautils import generate_width_trials
+    from riptide_tpu.search import periodogram_plan
+    from riptide_tpu.search.engine import (
+        collect_search_batch, prepare_stage_data, queue_search_batch,
+        ship_stage_data, warm_stage_kernels, _wire_layout, _wire_mode,
+        _ffa_path,
+    )
+
+    widths = tuple(int(w) for w in generate_width_trials(240))
+    plan = periodogram_plan(N, TSAMP, widths, 0.5, 3.0, 240, 260)
+    tobs = N * TSAMP
+
+    mode = _wire_mode(_ffa_path())
+    _, _, tot = _wire_layout(plan, mode)
+    print(f"wire mode {mode}: {tot * D / 1e6:.1f} MB per {D}-trial chunk",
+          flush=True)
+
+    # --- microbench: raw tunnel rate + latency ---
+    rng = np.random.default_rng(0)
+    buf = rng.integers(0, 255, (D, tot), dtype=np.uint8)
+    for k in range(3):
+        t0 = time.perf_counter()
+        dev = jnp.asarray(buf)
+        t1 = time.perf_counter()
+        _ = np.asarray(dev[0, :8])  # force completion
+        t2 = time.perf_counter()
+        print(f"  device_put {buf.nbytes/1e6:.0f} MB: call {t1-t0:.2f}s, "
+              f"complete {t2-t0:.2f}s -> {buf.nbytes/1e6/(t2-t0):.1f} MB/s",
+              flush=True)
+    tiny = np.zeros(8, np.float32)
+    for k in range(5):
+        t0 = time.perf_counter()
+        _ = np.asarray(jnp.asarray(tiny)[:1])
+        print(f"  rtt: {time.perf_counter()-t0:.3f}s", flush=True)
+
+    t0 = time.perf_counter()
+    nw = warm_stage_kernels(plan, D)
+    print(f"kernel warm ({nw}): {time.perf_counter()-t0:.1f}s", flush=True)
+
+    batches = [_make_batch(D, N, TSAMP, seed=k) for k in range(2)]
+    dms = np.zeros(D)
+
+    # Warmup pass (compiles engine programs / loads exec cache)
+    t0 = time.perf_counter()
+    h = queue_search_batch(plan, batches[0], tobs=tobs, **PKW)
+    collect_search_batch(h, dms)
+    print(f"warmup pass: {time.perf_counter()-t0:.1f}s", flush=True)
+
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        def prep(i):
+            t0 = time.perf_counter()
+            r = prepare_stage_data(plan, batches[i % 2])
+            return r, time.perf_counter() - t0
+
+        fut = ex.submit(prep, 0)
+        prepared, tprep = fut.result()
+        t0 = time.perf_counter()
+        shipped = ship_stage_data(plan, prepared)
+        tship = time.perf_counter() - t0
+        print(f"fill: prep {tprep:.2f}s ship {tship:.2f}s", flush=True)
+        fut = ex.submit(prep, 1)
+
+        pending = None
+        tstart = time.perf_counter()
+        for i in range(CHUNKS):
+            it0 = time.perf_counter()
+            t0 = time.perf_counter()
+            handle = queue_search_batch(plan, None, tobs=tobs,
+                                        shipped=shipped, **PKW)
+            tqueue = time.perf_counter() - t0
+            tship = tprep_i = twait = 0.0
+            if i + 1 < CHUNKS:
+                t0 = time.perf_counter()
+                prepared, tprep_i = fut.result()
+                twait = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                shipped = ship_stage_data(plan, prepared)
+                tship = time.perf_counter() - t0
+                if i + 2 < CHUNKS:
+                    fut = ex.submit(prep, i + 2)
+            tcollect = 0.0
+            if pending is not None:
+                t0 = time.perf_counter()
+                collect_search_batch(pending, dms)
+                tcollect = time.perf_counter() - t0
+            pending = handle
+            print(f"iter {i}: queue {tqueue:.2f}s  prep-wait {twait:.2f}s "
+                  f"(prep {tprep_i:.2f}s)  ship {tship:.2f}s  "
+                  f"collect {tcollect:.2f}s  total "
+                  f"{time.perf_counter()-it0:.2f}s", flush=True)
+        t0 = time.perf_counter()
+        collect_search_batch(pending, dms)
+        print(f"final collect: {time.perf_counter()-t0:.2f}s", flush=True)
+        dt = time.perf_counter() - tstart
+        print(f"steady: {CHUNKS} chunks in {dt:.2f}s = "
+              f"{D*CHUNKS/dt:.2f} trials/s", flush=True)
+
+
+if __name__ == "__main__":
+    D = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    CH = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    main(D, CH)
