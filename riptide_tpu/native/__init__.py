@@ -28,6 +28,7 @@ __all__ = [
     "downsample",
     "downsample_stages",
     "prepare_wire_u12",
+    "prepare_wire_u6",
     "prepare_wire_u8",
     "circular_prefix_sum",
     "boxcar_snr",
@@ -142,6 +143,8 @@ def _bind(lib):
         _f32("C_CONTIGUOUS"),                     # scales out (D, totscales)
         ctypes.c_void_p,                          # out (D, totbytes) u8
     ]
+    lib.rn_prepare_wire_u6.restype = None
+    lib.rn_prepare_wire_u6.argtypes = list(lib.rn_prepare_wire_u8.argtypes)
     lib.rn_prepare_wire_u12.restype = None
     lib.rn_prepare_wire_u12.argtypes = [
         _f32("C_CONTIGUOUS"), c64, c64,           # batch, D, N
@@ -363,6 +366,41 @@ def prepare_wire_u8(batch, imin, imax, wmin, wmax, wint, nouts, boffs,
     out = np.empty((D, int(totbytes)), np.uint8)
     scales = np.empty((D, int(totscales)), np.float32)
     lib.rn_prepare_wire_u8(
+        batch, D, N,
+        np.ascontiguousarray(imin, np.int32),
+        np.ascontiguousarray(imax, np.int32),
+        np.ascontiguousarray(wmin, np.float32),
+        np.ascontiguousarray(wmax, np.float32),
+        np.ascontiguousarray(wint, np.float32),
+        S, nout_pad,
+        np.ascontiguousarray(nouts, np.int32),
+        np.ascontiguousarray(boffs, np.int64),
+        int(totbytes),
+        np.ascontiguousarray(soffs, np.int64), int(totscales),
+        int(blkq), int(nthreads),
+        scales, out.ctypes.data,
+    )
+    return out, scales
+
+
+def prepare_wire_u6(batch, imin, imax, wmin, wmax, wint, nouts, boffs,
+                    totbytes, soffs, totscales, blkq=256, nthreads=None):
+    """
+    6-bit block-adaptive wire preparation: four samples in three bytes
+    with a per-``blkq``-sample-block scale = blockmax / 31 (bias 32).
+    Same layout contract as :func:`prepare_wire_u8` at 3/4 the bytes.
+
+    Returns (wire (D, totbytes) uint8, scales (D, totscales) float32).
+    """
+    lib = _require()
+    batch = np.ascontiguousarray(batch, np.float32)
+    D, N = batch.shape
+    S, nout_pad = imin.shape
+    if nthreads is None:
+        nthreads = min(max(os.cpu_count() or 1, 1), 32)
+    out = np.empty((D, int(totbytes)), np.uint8)
+    scales = np.empty((D, int(totscales)), np.float32)
+    lib.rn_prepare_wire_u6(
         batch, D, N,
         np.ascontiguousarray(imin, np.int32),
         np.ascontiguousarray(imax, np.int32),

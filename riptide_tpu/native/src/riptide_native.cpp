@@ -648,6 +648,78 @@ void rn_prepare_wire_u12(const float* batch, int64_t D, int64_t N,
     for (auto& th : pool) th.join();
 }
 
+// 6-bit block-adaptive wire: four samples in three bytes with a
+// PER-BLOCK scale = blockmax / 31 (bias 32; q in [1, 63]; 32 encodes
+// 0). 24-bit little-endian field order: q0 | q1<<6 | q2<<12 | q3<<18.
+// Stage spans are padded to whole blocks (pad fields encode 0).
+void rn_prepare_wire_u6(const float* batch, int64_t D, int64_t N,
+                        const int32_t* imin, const int32_t* imax,
+                        const float* wmin, const float* wmax,
+                        const float* wint, int64_t S, int64_t nout_pad,
+                        const int32_t* nouts, const int64_t* boffs,
+                        int64_t totbytes, const int64_t* soffs,
+                        int64_t totscales, int64_t blkq, int64_t nthreads,
+                        float* scales, uint8_t* out) {
+    std::vector<double> cs((N + 1) * D);
+    std::vector<std::thread> pool;
+    if (nthreads <= 0) nthreads = 1;
+    batch_prefix_sums(batch, D, N, cs.data(), nthreads);
+    std::atomic<int64_t> next_job(0);
+    const int64_t njobs = S * D;
+    for (int64_t t = 0; t < std::min<int64_t>(nthreads, njobs); ++t) {
+        pool.emplace_back([&]() {
+            std::vector<float> scratch;
+            int64_t job;
+            while ((job = next_job.fetch_add(1)) < njobs) {
+                const int64_t s = job / D, d = job % D;
+                const float* x = batch + d * N;
+                const double* c = cs.data() + d * (N + 1);
+                const int32_t* a = imin + s * nout_pad;
+                const int32_t* b = imax + s * nout_pad;
+                const float* w0 = wmin + s * nout_pad;
+                const float* w1 = wmax + s * nout_pad;
+                const float* wi = wint + s * nout_pad;
+                const int64_t n = nouts[s];
+                const int64_t nblk = (n + blkq - 1) / blkq;
+                scratch.resize(nblk * blkq);
+                float vmax_unused = 0.0f;
+                stage_values(x, c, a, b, w0, w1, wi, scratch.data(), n,
+                             &vmax_unused);
+                for (int64_t k = n; k < nblk * blkq; ++k) scratch[k] = 0.0f;
+                float* sc = scales + d * totscales + soffs[s];
+                uint8_t* o = out + d * totbytes + boffs[s];
+                const float magic = 12582912.0f;  // 1.5 * 2^23, RNE
+                for (int64_t bk = 0; bk < nblk; ++bk) {
+                    const float* v = scratch.data() + bk * blkq;
+                    float bmax = 0.0f;
+                    for (int64_t k = 0; k < blkq; ++k) {
+                        const float av = std::fabs(v[k]);
+                        if (av > bmax) bmax = av;
+                    }
+                    const float scale = bmax > 0.0f ? bmax / 31.0f : 1.0f;
+                    sc[bk] = scale;
+                    const float inv = 1.0f / scale;
+                    uint8_t* ob = o + bk * (blkq / 4) * 3;
+                    for (int64_t k = 0; k < blkq / 4; ++k) {
+                        uint32_t word = 0;
+                        for (int j = 0; j < 4; ++j) {
+                            union { float f; int32_t i; } u;
+                            u.f = v[4 * k + j] * inv + magic;
+                            const uint32_t q = static_cast<uint32_t>(
+                                ((u.i & 0x7FFFFF) - 4194304 + 32) & 63);
+                            word |= q << (6 * j);
+                        }
+                        ob[3 * k] = static_cast<uint8_t>(word & 255);
+                        ob[3 * k + 1] = static_cast<uint8_t>((word >> 8) & 255);
+                        ob[3 * k + 2] = static_cast<uint8_t>((word >> 16) & 255);
+                    }
+                }
+            }
+        });
+    }
+    for (auto& th : pool) th.join();
+}
+
 // 8-bit block-adaptive wire: like rn_prepare_wire_u12 but one byte per
 // sample with a PER-256-SAMPLE-BLOCK scale = blockmax / 127 (bias 128;
 // q in [1, 255]; 128 encodes 0). Block adaptivity confines the coarse

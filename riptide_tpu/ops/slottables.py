@@ -37,12 +37,26 @@ from .slotffa import node_sizes
 from .plan import num_levels
 
 __all__ = [
-    "KernelTables", "build_tables", "simulate_dense",
+    "KernelTables", "build_tables", "simulate_dense", "container_rows",
     "NAT_LEVELS", "SLOT_S",
 ]
 
 NAT_LEVELS = 3      # levels executed in natural layout
 SLOT_S = 8          # slot size after the spread (2**NAT_LEVELS)
+
+
+def container_rows(m, L):
+    """Container height for an m-row problem at bucket depth L: the
+    smaller of 2**L and 1.5 * 2**(L-1) = 3 * 2**(L-2) that still holds
+    m rows. The base-3 container cuts the ~1.44x average power-of-two
+    padding waste to ~1.19x; slot sizes become 3 * 2**j, which every
+    phase below supports (row-doubling only needs EVEN slot sizes, and
+    the spread/natural phases are container-size agnostic). Base-3 is
+    used only for L >= 5 so the container stays a multiple of the 8-row
+    sublane tile (3 * 2**(L-2) % 8 == 0 needs L >= 5)."""
+    if L >= 5 and 3 << (L - 2) >= m:
+        return 3 << (L - 2)
+    return 1 << L
 
 # packed word layout (int32):
 #   bits 0-10  sigma mod p            (lane roll;  < p <= 2047)
@@ -89,8 +103,10 @@ def _merge_tables(mn):
     return _merge_mapping(mn)
 
 
-def build_tables(m, p, L=None):
-    """Build all kernel tables for one (m, p) problem at bucket depth L."""
+def build_tables(m, p, L=None, R=None):
+    """Build all kernel tables for one (m, p) problem at bucket depth L
+    in a container of ``R`` rows (2**L, or 3 * 2**(L-2) — see
+    :func:`container_rows`; default 2**L)."""
     m, p = int(m), int(p)
     if not 0 < p <= PH_MASK:
         # sigma/thr live in PH_BITS-wide packed fields and the kernel's
@@ -103,9 +119,11 @@ def build_tables(m, p, L=None):
     L = Lmin if L is None else int(L)
     assert L >= Lmin
     NL = min(L, NAT_LEVELS)
-    rows = 1 << L
+    rows = (1 << L) if R is None else int(R)
+    legal = (1 << L,) + ((3 << (L - 2),) if L >= 2 else ())
+    assert rows >= m and rows in legal, (m, L, rows)
     t = KernelTables()
-    t.m, t.p, t.L, t.NL = m, p, L, NL
+    t.m, t.p, t.L, t.NL, t.rows = m, p, L, NL, rows
 
     # ---- natural phase -------------------------------------------------
     # Level l (1..NL) merges depth d+1 = L-l+1 children into depth d
@@ -174,11 +192,14 @@ def build_tables(m, p, L=None):
         hi = (mh > A).astype(np.int64)
         assert int(mh.max()) <= A + 1
         spread.append(A)
+        # Group size at step j is rows >> j (a multiple of 2 while
+        # j <= L - NL - 1 for both container forms); plain division
+        # rather than bit tricks so base-3 rows work too.
         half = rows >> (j + 1)
         iota = np.arange(rows)
-        g = iota >> (L - j)             # parent group
-        child = (iota >> (L - j - 1)) & 1
-        i = iota & (half - 1)
+        g = iota // (rows >> j)         # parent group
+        child = (iota // half) % 2
+        i = iota % half
         mh_g = mh[g]
         cnt = np.where(child == 0, mh_g, sizes[g] - mh_g)
         sel = np.where(child == 0, 0, 1 + hi[g])
@@ -196,7 +217,7 @@ def build_tables(m, p, L=None):
     slot_words = np.zeros((L - NL, rows), np.int32)
     for l in range(NL + 1, L + 1):
         d = L - l
-        S_d = 1 << l
+        S_d = rows >> d               # 2**l, or 3 * 2**(l-2) (base-3)
         sizes = node_sizes(m, d)
         csizes = node_sizes(m, d + 1)
         sig = np.zeros(rows, np.int64)
@@ -264,16 +285,16 @@ def _tail_lane_roll(tail, words, p, P):
     return np.where(cols[None, :] < thr[:, None], acc, wrapped)
 
 
-def simulate_dense(data, L=None, P=None):
+def simulate_dense(data, L=None, P=None, R=None):
     """
     Execute the kernel's dense-op sequence in numpy. `data` is (m, p);
     returns the (m, p) FFA transform (must equal ffa_transform exactly).
+    ``R`` selects the container height (see :func:`container_rows`).
     """
     data = np.asarray(data, dtype=np.float32)
     m, p = data.shape
-    t = build_tables(m, p, L)
-    L, NL = t.L, t.NL
-    rows = 1 << L
+    t = build_tables(m, p, L, R)
+    L, NL, rows = t.L, t.NL, t.rows
     P = p if P is None else int(P)
     cols = np.arange(P)
     colmask = (cols < p)[None, :]
@@ -325,7 +346,7 @@ def simulate_dense(data, L=None, P=None):
         db = ((w >> B_SHIFT) & ((1 << B_BITS) - 1)).astype(np.int64)
         d = L - l
         G = 1 << d
-        S_d = 1 << l
+        S_d = rows >> d
         S_c = S_d >> 1
         v = buf.reshape(G, 2, S_c, P)
         heads, tails = v[:, 0], v[:, 1]

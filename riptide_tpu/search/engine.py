@@ -216,23 +216,25 @@ def _wire_mode(path):
     a ~20-70 MB/s tunneled device the wire is the survey throughput
     ceiling, so bytes are the metric that matters.
 
-    'uint8' (default on the kernel path): one byte per sample with a
-    per-256-sample-block scale = blockmax / 127 — block adaptivity
-    confines coarse steps to the (rare) bright-signal blocks while
-    noise blocks quantise at ~4 sigma / 127; measured S/N error at the
-    18.5 oracle is ~0.01 (enforced by tests), at half float16's bytes.
-    'uint12': 12-bit, two samples in three bytes, per-(stage, trial)
-    scale (error <= max/4094 per sample). 'float16' costs ~5e-4
-    relative per sample; 'float32' is exact (gather-path default).
-    Override with RIPTIDE_WIRE_DTYPE=float32|float16|uint12|uint8.
+    'uint6' (default on the kernel path): four samples in three bytes
+    with a per-256-sample-block scale = blockmax / 31 — block
+    adaptivity confines coarse steps to the (rare) bright-signal
+    blocks while noise blocks quantise at ~4 sigma / 31; measured S/N
+    error at the 18.5 oracle is ~0.014 (enforced by tests), at 3/8 of
+    float16's bytes. 'uint8': one byte per sample, scale = blockmax /
+    127 (~0.009 at the oracle). 'uint12': 12-bit, two samples in three
+    bytes, per-(stage, trial) scale (error <= max/4094 per sample).
+    'float16' costs ~5e-4 relative per sample; 'float32' is exact
+    (gather-path default). Override with
+    RIPTIDE_WIRE_DTYPE=float32|float16|uint12|uint8|uint6.
     """
     mode = os.environ.get("RIPTIDE_WIRE_DTYPE")
     if mode:
-        mode = {"u12": "uint12", "u8": "uint8"}.get(mode, mode)
-        if mode not in ("float32", "float16", "uint12", "uint8"):
+        mode = {"u12": "uint12", "u8": "uint8", "u6": "uint6"}.get(mode, mode)
+        if mode not in ("float32", "float16", "uint12", "uint8", "uint6"):
             raise ValueError(f"unsupported RIPTIDE_WIRE_DTYPE={mode!r}")
         return mode
-    return "uint8" if path == "kernel" else "float32"
+    return "uint6" if path == "kernel" else "float32"
 
 
 # Quantisation block of the uint8 wire: one float32 scale per BLKQ
@@ -243,13 +245,15 @@ BLKQ = 256
 def _wire_layout(plan, mode):
     """Per-stage (offsets, lengths, total) of the flat wire buffer, in
     the mode's storage unit: BYTES for 'uint12' (each stage 3 bytes per
-    sample pair, odd sample counts padded by one) and 'uint8' (one byte
-    per sample, stages padded to whole BLKQ blocks), ELEMENTS
-    otherwise."""
+    sample pair, odd sample counts padded by one), 'uint8' (one byte
+    per sample, stages padded to whole BLKQ blocks) and 'uint6' (three
+    bytes per four samples, whole BLKQ blocks), ELEMENTS otherwise."""
     if mode == "uint12":
         lens = [3 * ((st.n + 1) // 2) for st in plan.stages]
     elif mode == "uint8":
         lens = [BLKQ * (-(-st.n // BLKQ)) for st in plan.stages]
+    elif mode == "uint6":
+        lens = [(BLKQ // 4) * 3 * (-(-st.n // BLKQ)) for st in plan.stages]
     else:
         lens = [st.n for st in plan.stages]
     offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
@@ -334,6 +338,86 @@ def _unpack_u8_padded(flat, scales, off, nb, soff, nblk, n, nout):
     sc = jax.lax.slice_in_dim(scales, soff, soff + nblk, axis=-1)
     xd = _u8_decode(seg, sc)[..., :n]
     return jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
+
+
+def _u6_decode(seg, scaleseg):
+    """(..., nblk * BLKQ * 3 // 4) uint8 wire bytes + (..., nblk) block
+    scales -> (..., nblk * BLKQ) float32 samples. Inverse of the packing
+    in native rn_prepare_wire_u6 (q0 | q1<<6 | q2<<12 | q3<<18)."""
+    lead = seg.shape[:-1]
+    nblk = seg.shape[-1] // (BLKQ // 4 * 3)
+    trip = seg.reshape(lead + (nblk * BLKQ // 4, 3)).astype(jnp.int32)
+    word = trip[..., 0] | (trip[..., 1] << 8) | (trip[..., 2] << 16)
+    q = jnp.stack([(word >> (6 * j)) & 63 for j in range(4)], axis=-1)
+    q = q.reshape(lead + (nblk, BLKQ)).astype(jnp.float32) - 32.0
+    return (q * scaleseg[..., None]).reshape(lead + (nblk * BLKQ,))
+
+
+@cached_jit(static_argnames=("off", "nb", "soff", "nblk", "n", "shapes",
+                             "rows", "P"))
+def _pack_static_u6(flat, scales, off, nb, soff, nblk, n, shapes, rows, P):
+    """uint6 counterpart of :func:`_pack_static_u8`."""
+    seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
+    sc = jax.lax.slice_in_dim(scales, soff, soff + nblk, axis=-1)
+    xd = _u6_decode(seg, sc)[..., :n]
+    outs = []
+    for m, p in shapes:
+        sub = xd[..., : m * p].reshape(xd.shape[:-1] + (m, p))
+        pad = [(0, 0)] * (sub.ndim - 2) + [(0, rows - m), (0, P - p)]
+        outs.append(jnp.pad(sub, pad))
+    return jnp.stack(outs, axis=-3)
+
+
+@cached_jit(static_argnames=("off", "nb", "soff", "nblk", "n", "nout"))
+def _unpack_u6_padded(flat, scales, off, nb, soff, nblk, n, nout):
+    """Gather-path uint6 unpack: decode one stage's samples and
+    zero-pad to the plan-wide padded length."""
+    seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
+    sc = jax.lax.slice_in_dim(scales, soff, soff + nblk, axis=-1)
+    xd = _u6_decode(seg, sc)[..., :n]
+    return jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
+
+
+def _prepare_u6(plan, batch):
+    """6-bit block-adaptive wire preparation: native single-pass when
+    available, vectorised numpy otherwise (bit-identical to native).
+    Returns (wire (D, totbytes) uint8, scales (D, total_blocks) f32)."""
+    from .. import native
+
+    offs, lens, tot = _wire_layout(plan, "uint6")
+    soffs, nblks, stot = _scale_layout(plan)
+    if native.available():
+        imin, imax, wmin, wmax, wint = _ds_pack(plan)
+        nouts = np.asarray([st.n for st in plan.stages], np.int32)
+        return native.prepare_wire_u6(
+            batch, imin, imax, wmin, wmax, wint, nouts, offs, tot,
+            soffs, stot, blkq=BLKQ,
+        )
+    d64, cs = _prefix64(batch)
+    D = batch.shape[0]
+    out = np.zeros((D, tot), np.uint8)
+    scales = np.empty((D, stot), np.float32)
+    for i, st in enumerate(plan.stages):
+        xd = _stage_downsample(st, d64, cs)[..., : st.n]
+        nblk = nblks[i]
+        pad = nblk * BLKQ - st.n
+        if pad:
+            xd = np.concatenate([xd, np.zeros((D, pad), np.float32)], axis=1)
+        blocks = xd.reshape(D, nblk, BLKQ)
+        bmax = np.abs(blocks).max(axis=2)
+        s = np.where(bmax > 0, bmax / 31.0, 1.0).astype(np.float32)
+        scales[:, soffs[i] : soffs[i] + nblk] = s
+        inv = (np.float32(1.0) / s).astype(np.float32)
+        q = (np.rint(blocks * inv[:, :, None]).astype(np.int32) + 32) & 63
+        quad = q.reshape(D, nblk * BLKQ // 4, 4)
+        word = (quad[..., 0] | (quad[..., 1] << 6) | (quad[..., 2] << 12)
+                | (quad[..., 3] << 18))
+        tmp = np.empty((D, word.shape[1], 3), np.uint8)
+        tmp[..., 0] = word & 255
+        tmp[..., 1] = (word >> 8) & 255
+        tmp[..., 2] = (word >> 16) & 255
+        out[:, offs[i] : offs[i] + lens[i]] = tmp.reshape(D, lens[i])
+    return out, scales
 
 
 def _prepare_u8(plan, batch):
@@ -447,11 +531,14 @@ def _kernel_eligible(st, plan):
     from, so the two cannot drift apart). Ineligible stages fall back to
     the gather path per stage."""
     from ..ops.ffa_kernel import PH_MASK, VMEM_LIMIT, kernel_vmem_bytes
-    from ..ops.slottables import NAT_LEVELS
+    from ..ops.slottables import NAT_LEVELS, container_rows
 
     L = st.kernel_depth
     NL = min(L, NAT_LEVELS)
-    rows = 1 << L
+    if os.environ.get("RIPTIDE_KERNEL_BASE3") == "0":
+        rows = 1 << L
+    else:
+        rows = container_rows(max(st.ms_padded), L)
     P = -(-max(st.ps_padded) // 128) * 128
     return (
         st.kernel_depth >= 3
@@ -473,6 +560,11 @@ def _run_stage_kernel(st, flat_dev, off, plan, meta, i):
     if meta["mode"] == "uint8":
         soffs, nblks = meta["soffs"], meta["nblks"]
         x = _pack_static_u8(flat_dev, meta["scales_dev"], off,
+                            meta["lens"][i], int(soffs[i]), nblks[i],
+                            st.n, shapes, kern.rows, kern.P)
+    elif meta["mode"] == "uint6":
+        soffs, nblks = meta["soffs"], meta["nblks"]
+        x = _pack_static_u6(flat_dev, meta["scales_dev"], off,
                             meta["lens"][i], int(soffs[i]), nblks[i],
                             st.n, shapes, kern.rows, kern.P)
     elif meta["mode"] == "uint12":
@@ -564,7 +656,8 @@ def prepare_stage_data(plan, batch, mode=None):
     execution of the current one (ctypes releases the GIL).
 
     Returns ``(flat, meta)`` where meta carries the path, wire mode,
-    per-stage offsets/lengths and (uint8/uint12) quantisation scales.
+    per-stage offsets/lengths and (uint8/uint6/uint12) quantisation
+    scales.
     """
     batch = np.asarray(batch, dtype=np.float32)
     if batch.ndim != 2 or batch.shape[1] != plan.size:
@@ -575,6 +668,8 @@ def prepare_stage_data(plan, batch, mode=None):
     scales = None
     if mode == "uint8":
         flat, scales = _prepare_u8(plan, batch)
+    elif mode == "uint6":
+        flat, scales = _prepare_u6(plan, batch)
     elif mode == "uint12":
         flat, scales = _prepare_u12(plan, batch)
     else:
@@ -612,7 +707,7 @@ def ship_stage_data(plan, prepared):
     meta = dict(meta)
     if meta["scales"] is not None:
         meta["scales_dev"] = jnp.asarray(meta["scales"])
-    if meta["mode"] == "uint8":
+    if meta["mode"] in ("uint8", "uint6"):
         soffs, nblks, _ = _scale_layout(plan)
         meta["soffs"], meta["nblks"] = soffs, nblks
     return parts, part_of, meta
@@ -637,6 +732,11 @@ def _queue_stages(plan, batch, prepared=None, shipped=None):
             outs.append(_run_stage_kernel(st, parts[c], off, plan, meta, i))
         elif mode == "uint8":
             xd = _unpack_u8_padded(parts[c], meta["scales_dev"], off,
+                                   meta["lens"][i], int(meta["soffs"][i]),
+                                   meta["nblks"][i], st.n, plan.nout)
+            outs.append(_run_stage_gather(st, xd, plan))
+        elif mode == "uint6":
+            xd = _unpack_u6_padded(parts[c], meta["scales_dev"], off,
                                    meta["lens"][i], int(meta["soffs"][i]),
                                    meta["nblks"][i], st.n, plan.nout)
             outs.append(_run_stage_gather(st, xd, plan))

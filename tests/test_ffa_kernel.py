@@ -96,6 +96,45 @@ def test_cycle_kernel_interpret_matches_oracle(ms, ps):
     _check_kernel(k, out, ms, ps, datas, widths, std)
 
 
+@pytest.mark.parametrize("m", [17, 24, 33, 48, 90, 96, 180, 192])
+def test_simulate_dense_base3_container(m):
+    """Base-3 (1.5 * 2**k) containers must stay oracle-exact."""
+    from riptide_tpu.ops.plan import num_levels
+    from riptide_tpu.ops.slottables import container_rows
+
+    L = num_levels(m)
+    R = container_rows(m, L)
+    assert R == 3 << (L - 2), (m, L, R)  # all cases chosen base-3
+    rng = np.random.default_rng(m)
+    data = rng.standard_normal((m, 19)).astype(np.float32)
+    np.testing.assert_array_equal(simulate_dense(data, R=R),
+                                  ffa_transform(data))
+
+
+@pytest.mark.parametrize("ms,ps", [
+    ([17, 20, 24], [10, 12, 9]),       # base-3 L=5 bucket (rows 24)
+    ([90, 96, 1], [33, 40, 33]),       # base-3 L=7 bucket (rows 96)
+])
+def test_cycle_kernel_interpret_base3(ms, ps):
+    """Interpret-mode kernel on base-3 buckets; rows must be 3 * 2**k
+    and results oracle-exact."""
+    widths = (1, 2, 3, 4)
+    k, x, datas, widths, std = _kernel_case(ms, ps, widths)
+    assert k.rows == 3 << (k.L - 2), (k.rows, k.L)
+    out = k(x)
+    _check_kernel(k, out, ms, ps, datas, widths, std)
+
+
+def test_cycle_kernel_base3_disable(monkeypatch):
+    """RIPTIDE_KERNEL_BASE3=0 forces the power-of-two container."""
+    monkeypatch.setenv("RIPTIDE_KERNEL_BASE3", "0")
+    k, x, datas, widths, std = _kernel_case([17, 20, 24], [10, 12, 9],
+                                            (1, 2, 3))
+    assert k.rows == 32
+    out = k(x)
+    _check_kernel(k, out, [17, 20, 24], [10, 12, 9], datas, widths, std)
+
+
 def test_cycle_kernel_streaming_tables(monkeypatch):
     """The per-level table-streaming fallback (used when the resident
     all-levels scratch would blow the VMEM budget) stays oracle-exact.

@@ -114,7 +114,7 @@ def test_snr_parity_oracle():
     assert abs(best_snr - 18.5) < 0.15
 
 
-@pytest.mark.parametrize("wire", ["float16", "uint12", "uint8"])
+@pytest.mark.parametrize("wire", ["float16", "uint12", "uint8", "uint6"])
 def test_snr_parity_oracle_lossy_wire(monkeypatch, wire):
     """The lossy host->device wire transports (float16, and the 12-bit
     12-bit packed option, and the 8-bit block-scaled default of the TPU

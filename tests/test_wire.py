@@ -136,3 +136,54 @@ def test_u8_search_close_to_exact(monkeypatch):
     monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "uint8")
     _, _, snr8 = run_periodogram(plan, data)
     assert np.max(np.abs(snr32 - snr8)) < 0.1
+
+
+def test_u6_roundtrip_error_bound():
+    """decode(encode(x)) within half a 6-bit block-quantisation step."""
+    from riptide_tpu.search.engine import _prepare_u6, _u6_decode
+
+    plan = _plan()
+    rng = np.random.default_rng(6)
+    batch = rng.standard_normal((3, plan.size)).astype(np.float32)
+    flat, scales = _prepare_u6(plan, batch)
+    offs, lens, tot = _wire_layout(plan, "uint6")
+    soffs, nblks, stot = _scale_layout(plan)
+    assert flat.shape == (3, tot) and scales.shape == (3, stot)
+    from riptide_tpu.search.engine import _host_downsample_all
+
+    xds = _host_downsample_all(plan, batch, np.float32)
+    for i, st in enumerate(plan.stages):
+        seg = flat[:, offs[i] : offs[i] + lens[i]]
+        sc = scales[:, soffs[i] : soffs[i] + nblks[i]]
+        dec = np.asarray(_u6_decode(seg, sc))[:, : st.n]
+        want = xds[i][..., : st.n]
+        step = np.repeat(sc, 256, axis=1)[:, : st.n]
+        assert np.all(np.abs(dec - want) <= 0.5 * step + 1e-6), i
+
+
+def test_u6_native_matches_numpy_fallback(monkeypatch):
+    from riptide_tpu.search.engine import _prepare_u6
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    plan = _plan()
+    rng = np.random.default_rng(7)
+    batch = rng.standard_normal((2, plan.size)).astype(np.float32)
+    got_flat, got_scales = _prepare_u6(plan, batch)
+    monkeypatch.setattr(native, "available", lambda: False)
+    want_flat, want_scales = _prepare_u6(plan, batch)
+    np.testing.assert_array_equal(got_scales, want_scales)
+    np.testing.assert_array_equal(got_flat, want_flat)
+
+
+def test_u6_search_close_to_exact(monkeypatch):
+    """Full periodogram through the uint6 wire stays within S/N 0.25 of
+    the float32-wire result at every trial (4x uint8's step)."""
+    plan = _plan()
+    rng = np.random.default_rng(8)
+    data = rng.standard_normal(plan.size).astype(np.float32)
+    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "float32")
+    _, _, snr32 = run_periodogram(plan, data)
+    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "uint6")
+    _, _, snr6 = run_periodogram(plan, data)
+    assert np.max(np.abs(snr32 - snr6)) < 0.25

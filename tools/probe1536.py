@@ -1,0 +1,114 @@
+"""Probe: do base-3 (rows = 3*2^k) slot-phase patterns compile on Mosaic?
+
+The 1536-row container's slot phase reshapes (R, P) -> (G, 2, S_c, P)
+with S_c = 3*2^(l-3) — NOT a multiple of the 8-row sublane tile for the
+first two slot levels (S_c = 6, 12). This script compiles and runs one
+pallas kernel per slot level at R = 1536, P = 384, checking output
+against the identical numpy sequence and timing REPS in-kernel passes.
+
+Run on the real TPU: python tools/probe1536.py
+"""
+import functools
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/riptide_tpu_jax_cache")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+R, P = 1536, 384
+L, NL = 11, 3
+
+
+NITER = 32
+
+
+def _one_pass(x, w, S_d):
+    G = R // S_d
+    S_c = S_d // 2
+    v = x.reshape(G, 2, S_c, P)
+    reph = jnp.repeat(v[:, 0], 2, axis=1)          # (G, S_d, P)
+    w3 = w.reshape(G, S_d, P)
+    da = (w3 >> 22) & 3
+    head = reph
+    for dv in (0, 1, 3):
+        delta = dv - 2
+        cand = pltpu.roll(reph, (-delta) % S_d, axis=1)
+        head = jnp.where(da == dv, cand, head)
+    rept = jnp.repeat(v[:, 1], 2, axis=1)
+    return (head + rept).reshape(R, P)
+
+
+def slot_level_kernel(x_ref, w_ref, o_ref, *, S_d):
+    """One slot level's head half: interleave + delta select (the
+    reshape pattern under test), plus the add. NITER in-kernel passes
+    amortize the tunnel dispatch cost."""
+    w = w_ref[:]
+
+    def step(_, x):
+        return _one_pass(x, w, S_d)
+
+    o_ref[:] = jax.lax.fori_loop(0, NITER, step, x_ref[:])
+
+
+def numpy_ref(x, w, S_d):
+    G = R // S_d
+    S_c = S_d // 2
+    v = x.reshape(G, 2, S_c, P)
+    reph = np.repeat(v[:, 0], 2, axis=1)
+    w3 = w.reshape(G, S_d, P)
+    da = (w3 >> 22) & 3
+    head = reph.copy()
+    for dv in (0, 1, 3):
+        delta = dv - 2
+        cand = np.roll(reph, -delta, axis=1)
+        head = np.where(da == dv, cand, head)
+    rept = np.repeat(v[:, 1], 2, axis=1)
+    return (head + rept).reshape(R, P)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((R, P)).astype(np.float32)
+    w = rng.integers(0, 4, (R, P), dtype=np.int32) << 22
+    xd, wd = jnp.asarray(x), jnp.asarray(w)
+    for l in range(NL + 1, L + 1):
+        S_d = (R >> (L - l))
+        kern = pl.pallas_call(
+            functools.partial(slot_level_kernel, S_d=S_d),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((R, P), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+        )
+        t0 = time.perf_counter()
+        try:
+            got = np.asarray(jax.jit(kern)(xd, wd))
+        except Exception as err:
+            print(f"l={l} S_d={S_d}: COMPILE/RUN FAIL: "
+                  f"{type(err).__name__}: {str(err)[:200]}", flush=True)
+            continue
+        tc = time.perf_counter() - t0
+        want = x
+        for _ in range(NITER):
+            want = numpy_ref(want, w, S_d)
+        ok = np.array_equal(got, want)
+        # steady-state: 4 dispatches of NITER in-kernel passes each
+        t0 = time.perf_counter()
+        for _ in range(4):
+            r = kern(xd, wd)
+        _ = np.asarray(r[0, 0])
+        dt = (time.perf_counter() - t0) / (4 * NITER)
+        print(f"l={l} S_d={S_d:5d} S_c={S_d//2:4d}: ok={ok} "
+              f"compile {tc:.1f}s, {dt*1e3:.3f} ms/pass", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
