@@ -17,15 +17,30 @@ removed upstream as a pessimization) measured on this machine at
 0.2511 s per DM trial on the identical config (tools/ref_bench.cpp,
 BASELINE.md). vs_baseline = our DM-trials/sec x 0.2511.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints the result as a JSON line {"metric", "value", "unit",
+"vs_baseline"}: one line after the FIRST timed pass (so a number is
+recorded even if a later pass stalls or the harness timeout hits), and
+— when time allows more passes — a final best-of-N line. The LAST line
+is authoritative. The run budgets itself against
+RIPTIDE_BENCH_BUDGET seconds of total process wall time (default 1380;
+the round-4 driver run was killed at >= 1570 s with no number emitted).
 Other BASELINE.json configs: --config 1..5 (see _CONFIGS).
 """
 import argparse
 import faulthandler
 import json
+import logging
 import os
 import sys
 import time
+
+_PROC_T0 = time.monotonic()
+BUDGET = float(os.environ.get("RIPTIDE_BENCH_BUDGET", "1380"))
+
+
+def _remaining():
+    return BUDGET - (time.monotonic() - _PROC_T0)
+
 
 if os.environ.get("RIPTIDE_BENCH_DEBUG"):
     # Periodic stack dumps to locate long compiles / stalls.
@@ -33,6 +48,11 @@ if os.environ.get("RIPTIDE_BENCH_DEBUG"):
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/riptide_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+# Surface the engine's per-bucket warm timings (loaded vs compiled) so a
+# slow cold start names its pole in the driver log.
+logging.basicConfig(stream=sys.stderr)
+logging.getLogger("riptide_tpu.search.engine").setLevel(logging.INFO)
 
 import numpy as np
 
@@ -93,10 +113,20 @@ def _parity_gate(plan, batch, tobs):
     assert dset == hset, f"device/host peak mismatch: {dset[:5]} vs {hset[:5]}"
     top = dev_peaks[0]
     assert abs(top.period - 1.0) < 1e-4, top
-    assert 16.0 < top.snr < 24.0, top
+    # Oracle-grade S/N band (VERDICT r4 item 5): the injected
+    # amplitude-20 pulsar's top S/N at THIS config (2^23 @ 64 us,
+    # batch-normalised) measured 17.31 (r03, uint8 wire) / 17.27 (r04,
+    # uint6) / 17.3 host float32 — the analog of the reference's
+    # 18.5 +/- 0.15 bar at its 2^19 @ 256 us config
+    # (riptide/tests/test_rseek.py:50-54).
+    assert abs(top.snr - 17.3) < 0.15, top
+    from riptide_tpu.search.engine import _ffa_path, _wire_mode
+
+    path = _ffa_path()
     print(
         f"parity gate: {len(dev_peaks)} peaks, top S/N {top.snr:.2f} "
-        f"at P = {top.period:.6f} s (device == host)",
+        f"at P = {top.period:.6f} s (device == host; path={path}, "
+        f"wire={_wire_mode(path)})",
         file=sys.stderr,
     )
 
@@ -150,10 +180,11 @@ def bench_headline():
         # chunk i+2 while the ship thread (wire-bound device_put) moves
         # chunk i+1 and the device computes chunk i; the main thread
         # only queues dispatches and syncs results. Steady state is
-        # max(prep, wire, device) rather than their sum. The fill
-        # (chunk 0's prep+ship) happens before the clock starts —
-        # steady-state survey throughput, matching the reference
-        # baseline's data-in-memory timing posture.
+        # max(prep, wire, device) rather than their sum. Only chunk 0's
+        # prep+ship (the pipeline fill) happens before the clock starts
+        # — steady-state survey throughput, matching the reference
+        # baseline's data-in-memory timing posture; every other chunk's
+        # prep AND wire transfer is inside the timed window.
         def prep_ship(i):
             fut = prepper.submit(prepare_stage_data, plan, batches[i % 2])
             return shipper.submit(
@@ -161,8 +192,8 @@ def bench_headline():
             )
         ship_futs = {0: prep_ship(0)}
         shipped = ship_futs.pop(0).result()
-        ship_futs[1] = prep_ship(1)
         t0 = time.perf_counter()
+        ship_futs[1] = prep_ship(1)
         pending = None
         for i in range(CHUNKS):
             handle = queue_search_batch(plan, None, tobs=tobs,
@@ -179,24 +210,40 @@ def bench_headline():
         assert peaks[0] and abs(peaks[0][0].period - 1.0) < 1e-4
         return time.perf_counter() - t0
 
+    def emit(elapsed, npasses):
+        trials_per_sec = D * CHUNKS / elapsed
+        print(
+            json.dumps(
+                {
+                    "metric": "dm_trials_per_sec_2p23_samples",
+                    "value": round(trials_per_sec, 3),
+                    "unit": "DM-trials/s",
+                    "vs_baseline": round(
+                        trials_per_sec * REF_SECONDS_PER_TRIAL, 2
+                    ),
+                }
+            ),
+            flush=True,
+        )
+        print(f"(best of {npasses} pipelined passes)", file=sys.stderr)
+
     with ThreadPoolExecutor(max_workers=1) as prepper, \
             ThreadPoolExecutor(max_workers=1) as shipper:
-        # Best of 3 pipelined passes — the same methodology as the
+        # Up to best-of-3 pipelined passes — the methodology of the
         # recorded reference baseline (best of 3, BASELINE.md); the
-        # device tunnel's transfer rate swings ~2x between runs.
-        elapsed = min(timed_pipeline(prepper, shipper) for _ in range(3))
-
-    trials_per_sec = D * CHUNKS / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "dm_trials_per_sec_2p23_samples",
-                "value": round(trials_per_sec, 3),
-                "unit": "DM-trials/s",
-                "vs_baseline": round(trials_per_sec * REF_SECONDS_PER_TRIAL, 2),
-            }
-        )
-    )
+        # device tunnel's transfer rate swings ~2x between runs. The
+        # FIRST pass's result is emitted immediately so the driver
+        # records a number even if a later pass stalls; further passes
+        # run only while the process-wall-time budget clearly covers
+        # them, and improvements are re-emitted (last line wins).
+        best = timed_pipeline(prepper, shipper)
+        emit(best, 1)
+        npasses = 1
+        while npasses < 3 and _remaining() > 1.5 * best + 60.0:
+            best = min(best, timed_pipeline(prepper, shipper))
+            npasses += 1
+        if npasses > 1:
+            emit(best, npasses)
 
 
 def _warm_plan(nsamp, tsamp, period_min, period_max, bins_min, bins_max,

@@ -120,7 +120,11 @@ def build_tables(m, p, L=None, R=None):
     assert L >= Lmin
     NL = min(L, NAT_LEVELS)
     rows = (1 << L) if R is None else int(R)
-    legal = (1 << L,) + ((3 << (L - 2),) if L >= 2 else ())
+    # Base-3 containers require L >= 5, matching container_rows: below
+    # that the container is not a multiple of the 8-row sublane tile and
+    # the spread/slot group halves come out odd — tables would build but
+    # the device path cannot serve them.
+    legal = (1 << L,) + ((3 << (L - 2),) if L >= 5 else ())
     assert rows >= m and rows in legal, (m, L, rows)
     t = KernelTables()
     t.m, t.p, t.L, t.NL, t.rows = m, p, L, NL, rows

@@ -23,12 +23,15 @@ Replaces the reference's single-threaded C++ search loop
 (riptide/cpp/periodogram.hpp:117-201) and its per-DM-trial OS process
 parallelism (riptide/pipeline/worker_pool.py) with one SPMD program.
 """
+import logging
 import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger("riptide_tpu.search.engine")
 
 from ..ops.downsample import downsample_gather, split_prefix_sums
 from ..utils.exec_cache import cached_jit
@@ -192,23 +195,48 @@ def _peak_plan(plan, tobs, **peak_kwargs):
     return pp
 
 
-@cached_jit(static_argnames=("off", "n", "shapes", "rows", "P"))
-def _pack_static(flat, off, n, shapes, rows, P):
-    """
-    Static pack, fused with the stage's slice of the all-stages wire
-    buffer: take flat[..., off : off+n], then per-problem reshape +
-    zero-pad into the (..., B, rows, P) float32 kernel container. Pure
-    data movement (no gather): problem b is xd[..., : m*p] viewed as
-    (m, p) then padded. One dispatch per stage — through the device
-    tunnel, per-dispatch overhead is material.
-    """
-    xd = jax.lax.slice_in_dim(flat, off, off + n, axis=-1).astype(jnp.float32)
+def _pack_container(xd, shapes, rows, P):
+    """Per-problem reshape + zero-pad of (..., n) samples into the
+    (..., B, rows, P) float32 kernel container. Pure data movement (no
+    gather): problem b is xd[..., : m*p] viewed as (m, p) then padded."""
     outs = []
     for m, p in shapes:
         seg = xd[..., : m * p].reshape(xd.shape[:-1] + (m, p))
         pad = [(0, 0)] * (seg.ndim - 2) + [(0, rows - m), (0, P - p)]
         outs.append(jnp.pad(seg, pad))
     return jnp.stack(outs, axis=-3)
+
+
+def _slice_decode(mode, flat, scales, off, nb, soff, nblk, n):
+    """Slice + decode ONE stage's samples out of the flat wire buffer:
+    the single definition of the wire transport's device-side inverse,
+    shared by every jitted pack/unpack wrapper below AND the sharded
+    path's in-shard_map decode (:func:`_stage_unpack`). ``scales`` is
+    the stage's scale operand (block scales for uint6/uint8, the
+    per-trial scale row for uint12, ignored for float modes). Returns
+    (..., n) float32."""
+    if mode in ("uint6", "uint8"):
+        seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
+        sc = jax.lax.slice_in_dim(scales, soff, soff + nblk, axis=-1)
+        dec = _u6_decode if mode == "uint6" else _u8_decode
+        return dec(seg, sc)[..., :n]
+    if mode == "uint12":
+        seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
+        return _u12_decode(seg, scales)[..., :n]
+    xd = jax.lax.slice_in_dim(flat, off, off + n, axis=-1)
+    return xd.astype(jnp.float32)
+
+
+@cached_jit(static_argnames=("off", "n", "shapes", "rows", "P"))
+def _pack_static(flat, off, n, shapes, rows, P):
+    """
+    Static pack, fused with the stage's slice of the all-stages wire
+    buffer: take flat[..., off : off+n], then :func:`_pack_container`.
+    One dispatch per stage — through the device tunnel, per-dispatch
+    overhead is material.
+    """
+    xd = _slice_decode("float", flat, None, off, 0, 0, 0, n)
+    return _pack_container(xd, shapes, rows, P)
 
 
 def _wire_mode(path):
@@ -285,22 +313,15 @@ def _pack_static_u12(flat, scale, off, nb, n, shapes, rows, P):
     """uint12 counterpart of :func:`_pack_static`: slice nb wire bytes,
     decode to float32 with the stage's per-trial scales, then the same
     per-problem reshape + zero-pad. One dispatch per stage."""
-    seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
-    xd = _u12_decode(seg, scale)[..., :n]
-    outs = []
-    for m, p in shapes:
-        sub = xd[..., : m * p].reshape(xd.shape[:-1] + (m, p))
-        pad = [(0, 0)] * (sub.ndim - 2) + [(0, rows - m), (0, P - p)]
-        outs.append(jnp.pad(sub, pad))
-    return jnp.stack(outs, axis=-3)
+    xd = _slice_decode("uint12", flat, scale, off, nb, 0, 0, n)
+    return _pack_container(xd, shapes, rows, P)
 
 
 @cached_jit(static_argnames=("off", "nb", "n", "nout"))
 def _unpack_u12_padded(flat, scale, off, nb, n, nout):
     """Gather-path uint12 unpack: decode one stage's samples and
     zero-pad to the plan-wide padded length."""
-    seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
-    xd = _u12_decode(seg, scale)[..., :n]
+    xd = _slice_decode("uint12", flat, scale, off, nb, 0, 0, n)
     return jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
 
 
@@ -319,24 +340,15 @@ def _pack_static_u8(flat, scales, off, nb, soff, nblk, n, shapes, rows, P):
     """uint8 counterpart of :func:`_pack_static`: slice nb wire bytes
     and the stage's block scales, decode, then the per-problem reshape +
     zero-pad. One dispatch per stage."""
-    seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
-    sc = jax.lax.slice_in_dim(scales, soff, soff + nblk, axis=-1)
-    xd = _u8_decode(seg, sc)[..., :n]
-    outs = []
-    for m, p in shapes:
-        sub = xd[..., : m * p].reshape(xd.shape[:-1] + (m, p))
-        pad = [(0, 0)] * (sub.ndim - 2) + [(0, rows - m), (0, P - p)]
-        outs.append(jnp.pad(sub, pad))
-    return jnp.stack(outs, axis=-3)
+    xd = _slice_decode("uint8", flat, scales, off, nb, soff, nblk, n)
+    return _pack_container(xd, shapes, rows, P)
 
 
 @cached_jit(static_argnames=("off", "nb", "soff", "nblk", "n", "nout"))
 def _unpack_u8_padded(flat, scales, off, nb, soff, nblk, n, nout):
     """Gather-path uint8 unpack: decode one stage's samples and
     zero-pad to the plan-wide padded length."""
-    seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
-    sc = jax.lax.slice_in_dim(scales, soff, soff + nblk, axis=-1)
-    xd = _u8_decode(seg, sc)[..., :n]
+    xd = _slice_decode("uint8", flat, scales, off, nb, soff, nblk, n)
     return jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
 
 
@@ -357,25 +369,37 @@ def _u6_decode(seg, scaleseg):
                              "rows", "P"))
 def _pack_static_u6(flat, scales, off, nb, soff, nblk, n, shapes, rows, P):
     """uint6 counterpart of :func:`_pack_static_u8`."""
-    seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
-    sc = jax.lax.slice_in_dim(scales, soff, soff + nblk, axis=-1)
-    xd = _u6_decode(seg, sc)[..., :n]
-    outs = []
-    for m, p in shapes:
-        sub = xd[..., : m * p].reshape(xd.shape[:-1] + (m, p))
-        pad = [(0, 0)] * (sub.ndim - 2) + [(0, rows - m), (0, P - p)]
-        outs.append(jnp.pad(sub, pad))
-    return jnp.stack(outs, axis=-3)
+    xd = _slice_decode("uint6", flat, scales, off, nb, soff, nblk, n)
+    return _pack_container(xd, shapes, rows, P)
 
 
 @cached_jit(static_argnames=("off", "nb", "soff", "nblk", "n", "nout"))
 def _unpack_u6_padded(flat, scales, off, nb, soff, nblk, n, nout):
     """Gather-path uint6 unpack: decode one stage's samples and
     zero-pad to the plan-wide padded length."""
-    seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
-    sc = jax.lax.slice_in_dim(scales, soff, soff + nblk, axis=-1)
-    xd = _u6_decode(seg, sc)[..., :n]
+    xd = _slice_decode("uint6", flat, scales, off, nb, soff, nblk, n)
     return jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
+
+
+def _stage_unpack(meta, i, flat, scales, n, nout=None):
+    """Stage ``i``'s :func:`_slice_decode` driven by the wire meta;
+    traceable anywhere (plain ops, no jit) so the sharded path can run
+    it INSIDE ``shard_map`` on each dm shard. ``flat``/``scales`` may
+    carry any leading batch dims. Returns (..., n) float32, zero-padded
+    to ``nout`` when given."""
+    mode = meta["mode"]
+    if mode in ("uint6", "uint8"):
+        soff, nblk = int(meta["soffs"][i]), int(meta["nblks"][i])
+    else:
+        soff, nblk = 0, 0
+        if mode == "uint12":
+            scales = scales[i]
+    xd = _slice_decode(mode, flat, scales,
+                       int(meta["offs"][i]), int(meta["lens"][i]),
+                       soff, nblk, n)
+    if nout is not None and nout > n:
+        xd = jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
+    return xd
 
 
 def _prepare_u6(plan, batch):
@@ -855,6 +879,11 @@ def warm_stage_kernels(plan, D, parallel=True):
     else:
         for c in calls.values():
             c.warm()
+    for c in calls.values():
+        # key = (L, NL, rows, P, RS, widths, nspread, pbits, D, B, resident)
+        k = c.key
+        log.info("bucket L=%d rows=%d P=%d B=%d D=%d: %s in %.1fs",
+                 k[0], k[2], k[3], k[9], k[8], c.source, c.warm_seconds)
     return len(calls)
 
 

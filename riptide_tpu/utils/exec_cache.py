@@ -16,7 +16,10 @@ wraps ``jax.jit`` with a disk cache of serialized executables:
 
 The whole-package source hash is deliberately coarse: any source edit
 invalidates every cached engine program (correctness over warm starts).
-The Pallas cycle kernel keeps its own narrower cache in ops/ffa_kernel.
+These programs recompile in ~15 s each (~3 min total for a survey), so
+a content-keyed miss is an acceptable cost; the Pallas cycle kernel,
+whose compiles run 10-50 MINUTES, keeps its own narrower version-keyed
+cache in ops/ffa_kernel so only semantic kernel changes invalidate it.
 """
 import functools
 import hashlib
@@ -30,12 +33,33 @@ import jax
 
 log = logging.getLogger("riptide_tpu.exec_cache")
 
-__all__ = ["cached_jit", "load_or_compile_exec"]
+__all__ = ["cached_jit", "load_or_compile_exec", "cache_root"]
+
+
+def cache_root():
+    """Root directory for the on-disk executable caches.
+
+    Precedence: ``RIPTIDE_CACHE_ROOT``; a ``.riptide_cache`` directory
+    at the checkout root (the package's parent) when that location is
+    writable — unlike a tempdir it is guaranteed to survive into every
+    later process run from the same checkout, in particular the
+    driver's end-of-round benchmark run; else a per-user tempdir
+    (0700: entries are pickles, the directory must not be writable by
+    other local users)."""
+    env = os.environ.get("RIPTIDE_CACHE_ROOT")
+    if env:
+        return env
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    if os.access(repo, os.W_OK):
+        return os.path.join(repo, ".riptide_cache")
+    return os.path.join(tempfile.gettempdir(),
+                        f"riptide_tpu_cache_{os.getuid()}")
+
 
 _DIR = os.environ.get(
-    "RIPTIDE_EXEC_CACHE",
-    os.path.join(tempfile.gettempdir(),
-                 f"riptide_tpu_exec_cache_{os.getuid()}"),
+    "RIPTIDE_EXEC_CACHE", os.path.join(cache_root(), "exec")
 )
 
 _lock = threading.Lock()
@@ -58,23 +82,31 @@ def _src_hash():
     return _src_hash_memo
 
 
-def load_or_compile_exec(path, jitted, args, kw=None, name="program"):
+def load_or_compile_exec(path, jitted, args, kw=None, name="program",
+                         info=None):
     """Deserialize a compiled executable from ``path``, or AOT-compile
     ``jitted`` at ``args``/``kw`` and store it there (atomic write,
     0700 parent dir). Returns a compiled callable taking only the ARRAY
-    arguments (statics are baked in by ``lower``). Shared by the
-    generic :func:`cached_jit` wrapper and the Pallas cycle-kernel cache
-    (ops/ffa_kernel.py), which keys its entries more narrowly."""
+    arguments (statics are baked in by ``lower``). When ``info`` is a
+    dict, ``info['action']`` records what actually happened ('loaded'
+    or 'compiled' — a corrupt entry falls through to a compile). Shared
+    by the generic :func:`cached_jit` wrapper and the Pallas
+    cycle-kernel cache (ops/ffa_kernel.py), which keys its entries more
+    narrowly."""
     from jax.experimental import serialize_executable as se
 
+    if info is None:
+        info = {}
     if os.path.exists(path):
         try:
             with open(path, "rb") as f:
                 payload, in_tree, out_tree = pickle.load(f)
+            info["action"] = "loaded"
             return se.deserialize_and_load(payload, in_tree, out_tree)
         except Exception as err:
             log.warning("exec cache load failed for %s (%s); recompiling",
                         name, err)
+    info["action"] = "compiled"
     compiled = jitted.lower(*args, **(kw or {})).compile()
     try:
         d = os.path.dirname(path)
@@ -121,7 +153,12 @@ class _Cached:
             if tok is not None:
                 parts.append(("t", tok))
             elif _is_array(a):
-                parts.append(("a", tuple(a.shape), str(a.dtype)))
+                # Sharding is part of the AOT executable's signature: a
+                # dm-sharded and an unsharded call with identical shapes
+                # must not share one compiled program.
+                sh = getattr(a, "sharding", None)
+                parts.append(("a", tuple(a.shape), str(a.dtype),
+                              str(sh) if sh is not None else ""))
             else:
                 parts.append(("s", repr(a)))
         return hashlib.sha1(repr(parts).encode()).hexdigest()
