@@ -31,6 +31,8 @@ __all__ = [
     "prepare_wire_u6",
     "prepare_wire_u8",
     "circular_prefix_sum",
+    "rollback",
+    "fused_rollback_add",
     "boxcar_snr",
 ]
 
@@ -40,7 +42,14 @@ _BUILD_DIR = os.path.join(_HERE, "_build")
 # Compile flags are part of the cache key: a .so built with different
 # flags (e.g. an old -march=native artifact on a shared filesystem) must
 # not pass the staleness check on a host it could crash.
-_FLAGS = ("-O3", "-std=c++17", "-shared", "-fPIC", "-pthread")
+# -ffp-contract=off: the u6/u8/u12 quantisers' round-to-nearest-even
+# via the 1.5*2^23 magic constant is byte-identical to the numpy
+# fallback only if `v * inv + magic` is NOT contracted to an FMA;
+# baseline x86-64 has no FMA but aarch64 GCC defaults to
+# -ffp-contract=fast with hardware FMA, which would silently break the
+# wire byte-parity the block scales and tests depend on.
+_FLAGS = ("-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+          "-ffp-contract=off")
 
 
 def _flags_tag():
@@ -117,6 +126,15 @@ def _bind(lib):
     f64p = ndpointer(np.float64, flags="C_CONTIGUOUS")
     lib.rn_circular_prefix_sum.restype = None
     lib.rn_circular_prefix_sum.argtypes = [_f32("C_CONTIGUOUS"), c64, c64, f64p]
+    lib.rn_rollback.restype = None
+    lib.rn_rollback.argtypes = [
+        _f32("C_CONTIGUOUS"), c64, c64, _f32("C_CONTIGUOUS"),
+    ]
+    lib.rn_fused_rollback_add.restype = None
+    lib.rn_fused_rollback_add.argtypes = [
+        _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"), c64, c64,
+        _f32("C_CONTIGUOUS"),
+    ]
     i64p = ndpointer(np.int64, flags="C_CONTIGUOUS")
     lib.rn_boxcar_snr.restype = None
     lib.rn_boxcar_snr.argtypes = [
@@ -265,6 +283,31 @@ def downsample(data, f):
     nout = int(np.floor(data.size / f))
     out = np.empty(nout, np.float32)
     lib.rn_downsample(data, data.size, float(f), out)
+    return out
+
+
+def rollback(data, shift):
+    """out = roll(data, -shift): the elementary FFA phase rotation,
+    exposed for testing like the reference's libcpp.rollback
+    (riptide/cpp/python_bindings.cpp:32-44)."""
+    lib = _require()
+    data = np.ascontiguousarray(data, np.float32)
+    out = np.empty_like(data)
+    lib.rn_rollback(data, data.size, int(shift), out)
+    return out
+
+
+def fused_rollback_add(x, y, shift):
+    """out = x + roll(y, -shift): the fused FFA merge kernel, exposed
+    for testing like the reference's libcpp.fused_rollback_add
+    (riptide/cpp/python_bindings.cpp:46-55)."""
+    lib = _require()
+    x = np.ascontiguousarray(x, np.float32)
+    y = np.ascontiguousarray(y, np.float32)
+    if x.shape != y.shape:
+        raise ValueError("x and y must have the same shape")
+    out = np.empty_like(x)
+    lib.rn_fused_rollback_add(x, y, x.size, int(shift), out)
     return out
 
 

@@ -155,6 +155,23 @@ void rn_ffa_transform(const float* in, int64_t m, int64_t p, float* out) {
     std::memcpy(out, cur, sizeof(float) * m * p);
 }
 
+// Elementary kernels, exposed purely for testing (like the reference's
+// libcpp.rollback / fused_rollback_add, python_bindings.cpp:32-55):
+// out = roll(x, -shift) as two contiguous spans, and z = x + that.
+
+void rn_rollback(const float* x, int64_t n, int64_t shift, float* out) {
+    const int64_t s = ((shift % n) + n) % n;
+    std::memcpy(out, x + s, sizeof(float) * (n - s));
+    std::memcpy(out + (n - s), x, sizeof(float) * s);
+}
+
+void rn_fused_rollback_add(const float* x, const float* y, int64_t n,
+                           int64_t shift, float* out) {
+    const int64_t s = ((shift % n) + n) % n;
+    for (int64_t j = 0; j < n - s; ++j) out[j] = x[j] + y[j + s];
+    for (int64_t j = n - s; j < n; ++j) out[j] = x[j] + y[j + s - n];
+}
+
 // Seconds per transform of an (rows, cols) random array, best timing
 // over `loops` runs (the benchmark_ffa2 analog).
 double rn_benchmark_ffa(int64_t rows, int64_t cols, int64_t loops) {

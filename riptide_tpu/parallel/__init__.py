@@ -20,7 +20,14 @@ Multi-host: :func:`init_distributed` wraps ``jax.distributed.initialize``;
 all-gathers the Peak lists; all collectives ride XLA over ICI/DCN.
 """
 from .mesh import default_mesh, mesh_2d
-from .sharded import run_periodogram_sharded, run_search_sharded
+from .sharded import (
+    collect_search_sharded,
+    prepare_stage_data_sharded,
+    queue_search_sharded,
+    run_periodogram_sharded,
+    run_search_sharded,
+    ship_stage_data_sharded,
+)
 from .seqffa import ffa2_seq, seq_mesh
 from .distributed import init_distributed
 from .multihost import gather_peaks, run_search_multihost
@@ -30,6 +37,10 @@ __all__ = [
     "mesh_2d",
     "run_periodogram_sharded",
     "run_search_sharded",
+    "queue_search_sharded",
+    "collect_search_sharded",
+    "prepare_stage_data_sharded",
+    "ship_stage_data_sharded",
     "ffa2_seq",
     "seq_mesh",
     "init_distributed",

@@ -9,14 +9,22 @@ Two distributed entry points:
   (and, for the XLA gather path, the phase-bin-trial batch over an
   optional ``bins`` axis). Returns the full S/N cube — use it when the
   periodogram itself is the product.
-* :func:`run_search_sharded` — the survey path (SURVEY §2c/§5): the S/N
-  cube stays device-resident and dm-sharded; peak detection runs on
-  device, and only fixed-size (trial index, S/N) peak buffers — a few
-  KB per DM trial — are gathered to the host, mirroring the reference's
-  tiny-pickled-Peaks worker contract
-  (riptide/pipeline/worker_pool.py:47-71, CHANGELOG 0.1.4).
+* :func:`queue_search_sharded` / :func:`collect_search_sharded` (and
+  the one-shot :func:`run_search_sharded`) — the survey path (SURVEY
+  §2c/§5): the S/N cube stays device-resident and dm-sharded; peak
+  detection runs on device, and only fixed-size (trial index, S/N)
+  peak buffers — a few KB per DM trial — are gathered to the host,
+  mirroring the reference's tiny-pickled-Peaks worker contract
+  (riptide/pipeline/worker_pool.py:47-71, CHANGELOG 0.1.4). The
+  queue/collect split lets callers enqueue batch i+1 before paying
+  batch i's device->host round trip, exactly like the unsharded
+  engine path (pipeline.batcher uses this for mesh queue-ahead).
 
-Every shard of stage work is independent — the SPMD programs contain no
+The survey path ships the QUANTISED wire (uint6 by default on the
+kernel path — the same block-scaled transport as the unsharded engine,
+decoded per shard inside ``shard_map``), so the 8-chip story keeps the
+3x byte saving exactly where the wire is 8x more contended. Every
+shard of stage work is independent — the SPMD programs contain no
 collectives; the Pallas cycle kernel runs per-shard inside shard_map on
 its local (D/n_dm, B) grid. The bins axis is only supported on the
 gather path (the fused kernel serves a full bins-trial bucket per
@@ -25,31 +33,97 @@ program); a bins-sharded mesh falls back to the gather path per stage.
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as Pspec
+from jax.sharding import NamedSharding, PartitionSpec as Pspec
 
 from ..search.engine import (
     _assemble,
     _assemble_device,
+    _ffa_path,
     _kernel_eligible,
-    _pack_static,
+    _pack_container,
+    _peak_plan,
+    _scale_layout,
     _stage_operands,
+    _stage_unpack,
+    _wire_mode,
+    prepare_stage_data,
 )
 
-__all__ = ["run_periodogram_sharded", "run_search_sharded"]
+__all__ = ["run_periodogram_sharded", "run_search_sharded",
+           "queue_search_sharded", "collect_search_sharded",
+           "prepare_stage_data_sharded", "ship_stage_data_sharded"]
 
 
-def _stage_sharded_call(mesh, st, plan, path, with_bins):
+def _pad_dm(batch, mesh):
+    """Zero-pad the DM axis up to a multiple of the mesh's dm axis."""
+    D = batch.shape[0]
+    dm_size = mesh.shape["dm"]
+    Dpad = -(-D // dm_size) * dm_size
+    if Dpad != D:
+        batch = np.concatenate(
+            [batch, np.zeros((Dpad - D,) + batch.shape[1:], batch.dtype)]
+        )
+    return batch, D
+
+
+def prepare_stage_data_sharded(plan, batch, mesh, mode=None):
+    """HOST half of a sharded search: pad the (D, N) batch to the mesh's
+    dm axis, then run the same native wire preparation as the unsharded
+    engine (quantised transport included). Returns ``(prepared, D)``
+    with D the original (unpadded) trial count."""
+    batch = np.asarray(batch, dtype=np.float32)
+    if batch.ndim != 2 or batch.shape[1] != plan.size:
+        raise ValueError("batch must be (D, N) with N matching the plan")
+    batch, D = _pad_dm(batch, mesh)
+    flat, meta = prepare_stage_data(plan, batch, mode=mode)
+    meta["D_original"] = D
+    return (flat, meta), D
+
+
+def ship_stage_data_sharded(plan, prepared, mesh):
+    """Start the dm-sharded host->device transfer of a prepared wire
+    buffer (one device_put per array; each device receives only its
+    D/n_dm slice). Returns ``(flat_dev, meta)`` for
+    :func:`queue_search_sharded`'s ``shipped``."""
+    flat, meta = prepared
+    dmsh = NamedSharding(mesh, Pspec("dm", None))
+    flat_dev = jax.device_put(flat, dmsh)
+    meta = dict(meta)
+    if meta["scales"] is not None:
+        if meta["mode"] == "uint12":
+            # (S, D) layout: dm is the second axis.
+            sc_sh = NamedSharding(mesh, Pspec(None, "dm"))
+        else:
+            sc_sh = dmsh
+        meta["scales_dev"] = jax.device_put(meta["scales"], sc_sh)
+    if meta["mode"] in ("uint8", "uint6"):
+        soffs, nblks, _ = _scale_layout(plan)
+        meta["soffs"], meta["nblks"] = soffs, nblks
+    return flat_dev, meta
+
+
+def _stage_sharded_call(mesh, st, plan, meta, i, with_bins):
     """Build (and cache on the stage) the shard_mapped program for one
-    cascade stage on one mesh layout."""
+    cascade stage on one mesh layout + wire mode. The local function
+    decodes the stage's slice of the wire INSIDE shard_map (each shard
+    unpacks only its own DM trials) and then runs the fused kernel or
+    the gather formulation on the local shard."""
     cache = getattr(st, "_sharded_calls", None)
     if cache is None:
         cache = st._sharded_calls = {}
-    key = (mesh, path, with_bins)
+    path = meta["path"]
+    mode = meta["mode"]
+    key = (mesh, path, mode, with_bins)
     fn = cache.get(key)
     if fn is not None:
         return fn
 
     dm = Pspec("dm")
+    dm2 = Pspec("dm", None)
+    # uint12 scales are (S, D); uint6/uint8 scales are (D, stot).
+    sc_spec = Pspec(None, "dm") if mode == "uint12" else dm2
+    has_scales = mode in ("uint6", "uint8", "uint12")
+    n = st.n
     use_kernel = (
         path == "kernel" and not with_bins and _kernel_eligible(st, plan)
     )
@@ -61,64 +135,62 @@ def _stage_sharded_call(mesh, st, plan, path, with_bins):
         remax = max(st.rows_eval_max, 1)
         nw = len(plan.widths)
 
-        def local(xd):
-            x = _pack_static(xd, 0, st.n, shapes, kern.rows, kern.P)
+        def local(flat, *scales):
+            xd = _stage_unpack(meta, i, flat, *(scales or (None,)), n=n)
+            x = _pack_container(xd, shapes, kern.rows, kern.P)
             return kern(x)[..., :remax, :nw]
 
-        fn = jax.jit(jax.shard_map(
-            local, mesh=mesh, in_specs=(dm,), out_specs=dm
+        in_specs = (dm2, sc_spec) if has_scales else (dm2,)
+        smapped = jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=in_specs, out_specs=dm
         ))
 
-        def wrapped(xd, fn=fn):
-            return fn(xd)
+        def wrapped(flat_dev, meta_dev, smapped=smapped):
+            args = ((meta_dev["scales_dev"],) if has_scales else ())
+            return smapped(flat_dev, *args)
     else:
         from ..search.engine import _gather_cycle_xd
 
         b = "bins" if with_bins else None
-        rep = Pspec()
-        in_specs = (
-            dm,
-            Pspec(None, b, None), Pspec(None, b, None), Pspec(None, b, None),
-            Pspec(b), Pspec(b),
-            Pspec(b, None), Pspec(b, None), Pspec(b),
-        )
-        widths, P = plan.widths, plan.P
+        widths, P, nout = plan.widths, plan.P, plan.nout
 
-        def local(xd, h, t, shift, p, m, hcoef, bcoef, stdnoise):
+        def local(flat, scales, h, t, shift, p, m, hcoef, bcoef, stdnoise):
+            xd = _stage_unpack(meta, i, flat, scales, n=n, nout=nout)
             return _gather_cycle_xd(
                 xd, h, t, shift, p, m, hcoef, bcoef, stdnoise, widths, P
             )
 
-        fn = jax.jit(jax.shard_map(
+        in_specs = (
+            dm2, sc_spec,
+            Pspec(None, b, None), Pspec(None, b, None), Pspec(None, b, None),
+            Pspec(b), Pspec(b),
+            Pspec(b, None), Pspec(b, None), Pspec(b),
+        )
+        smapped = jax.jit(jax.shard_map(
             local, mesh=mesh, in_specs=in_specs,
             out_specs=Pspec("dm", b, None, None),
         ))
 
-        def wrapped(xd, fn=fn, st=st):
+        def wrapped(flat_dev, meta_dev, smapped=smapped, st=st):
             ops = _stage_operands(st)
-            return fn(
-                xd, ops["h"], ops["t"], ops["shift"], ops["p"], ops["m"],
-                ops["hcoef"], ops["bcoef"], ops["stdnoise"],
+            scales = meta_dev.get("scales_dev")
+            if scales is None:
+                # Placeholder operand so the program signature is
+                # uniform; float modes never read it.
+                scales = jnp.zeros((flat_dev.shape[0], 1), jnp.float32)
+            return smapped(
+                flat_dev, scales, ops["h"], ops["t"], ops["shift"],
+                ops["p"], ops["m"], ops["hcoef"], ops["bcoef"],
+                ops["stdnoise"],
             )
     cache[key] = wrapped
     return wrapped
 
 
-def _queue_stages_sharded(plan, batch, mesh):
-    """Pad the DM axis to the mesh, then queue every cascade stage as a
-    shard_mapped program. Returns (outs, D_original)."""
+def _queue_stages_sharded(plan, batch, mesh, shipped=None, mode=None):
+    """Queue every cascade stage as a shard_mapped program fed from the
+    dm-sharded wire buffer. Returns (outs, D_original)."""
     with_bins = "bins" in mesh.axis_names
-    dm_size = mesh.shape["dm"]
-
-    batch = np.asarray(batch, dtype=np.float32)
-    if batch.ndim != 2 or batch.shape[1] != plan.size:
-        raise ValueError("batch must be (D, N) with N matching the plan")
-    D = batch.shape[0]
-    Dpad = -(-D // dm_size) * dm_size
-    if Dpad != D:
-        batch = np.concatenate(
-            [batch, np.zeros((Dpad - D, plan.size), np.float32)]
-        )
     if with_bins:
         B = len(plan.stages[0].ps_padded)
         if B % mesh.shape["bins"]:
@@ -126,30 +198,18 @@ def _queue_stages_sharded(plan, batch, mesh):
                 f"bins mesh axis size {mesh.shape['bins']} does not divide "
                 f"the plan's padded bins-trial count {B}"
             )
-
-    from ..search.engine import _ffa_path, _wire_mode, prepare_stage_data
-
-    # The sharded wire stays in a float dtype (element-addressed slices
-    # below); the 12-bit byte-packed transport is wired through the
-    # unsharded survey path only. An explicit RIPTIDE_WIRE_DTYPE float
-    # override is still honored.
-    wire = _wire_mode(_ffa_path())
-    if wire == "uint12":
-        wire = "float16" if _ffa_path() == "kernel" else "float32"
-    flat, meta = prepare_stage_data(plan, batch, mode=wire)
-    path = meta["path"]
-    flat_dev = jnp.asarray(flat)  # ONE host->device transfer
+    if shipped is None:
+        prepared, D = prepare_stage_data_sharded(plan, batch, mesh, mode=mode)
+        shipped = ship_stage_data_sharded(plan, prepared, mesh)
+    else:
+        D = shipped[1].get("D_original")
+        if D is None:
+            D = shipped[0].shape[0]
+    flat_dev, meta = shipped
     outs = []
-    off = 0
-    for st in plan.stages:
-        xd = jax.lax.slice_in_dim(flat_dev, off, off + st.n, axis=1)
-        off += st.n
-        if not (path == "kernel" and not with_bins
-                and _kernel_eligible(st, plan)):
-            xd = jnp.pad(xd.astype(jnp.float32),
-                         [(0, 0), (0, plan.nout - st.n)])
-        call = _stage_sharded_call(mesh, st, plan, path, with_bins)
-        outs.append(call(xd))
+    for i, st in enumerate(plan.stages):
+        call = _stage_sharded_call(mesh, st, plan, meta, i, with_bins)
+        outs.append(call(flat_dev, meta))
     return outs, D
 
 
@@ -173,26 +233,52 @@ def run_periodogram_sharded(plan, batch, mesh=None):
     return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs
 
 
-def run_search_sharded(plan, batch, tobs, dms=None, mesh=None, **peak_kwargs):
-    """
-    Distributed survey search with on-device peak detection: the
-    dm-sharded S/N cube never leaves the devices; only KB-sized peak
-    buffers are gathered. Returns (peaks_per_trial, polycos_per_trial)
-    for the ORIGINAL (unpadded) D trials.
-    """
+def queue_search_sharded(plan, batch, tobs, mesh=None, shipped=None,
+                         mode=None, **peak_kwargs):
+    """Enqueue one dm-sharded batch's ENTIRE device side — wire decode,
+    periodogram stages, device assembly, fused peak detection — without
+    syncing. Returns an opaque handle for
+    :func:`collect_search_sharded`; queue batch i+1 before collecting
+    batch i and the devices never idle on the host round trip."""
     from .mesh import default_mesh
-    from ..search.engine import _peak_plan
-    from ..search.peaks_device import device_find_peaks
+    from ..search.peaks_device import queue_find_peaks
 
     if mesh is None:
         mesh = default_mesh()
+    pp = _peak_plan(plan, tobs, **peak_kwargs)
+    outs, D = _queue_stages_sharded(plan, batch, mesh, shipped=shipped,
+                                    mode=mode)
+    snr_dev = _assemble_device(plan, *outs)
+    return pp, queue_find_peaks(pp, snr_dev), D
+
+
+def collect_search_sharded(handle, dms):
+    """Sync one queued sharded batch: gather the fused peak buffer and
+    finish on host. Returns (peaks_per_trial, polycos_per_trial) trimmed
+    to the original (unpadded) D trials."""
+    from ..search.peaks_device import collect_peaks
+
+    pp, peaks_handle, D = handle
+    Dpad = peaks_handle[1].shape[0]
+    dms_full = np.concatenate(
+        [np.asarray(dms, float), np.zeros(Dpad - len(dms))]
+    )
+    peaks, polycos = collect_peaks(pp, peaks_handle, dms_full)
+    return peaks[:D], polycos[:D]
+
+
+def run_search_sharded(plan, batch, tobs, dms=None, mesh=None, mode=None,
+                       **peak_kwargs):
+    """
+    Distributed survey search with on-device peak detection (queue +
+    collect in one): the dm-sharded S/N cube never leaves the devices;
+    only KB-sized peak buffers are gathered. Returns
+    (peaks_per_trial, polycos_per_trial) for the ORIGINAL (unpadded) D
+    trials.
+    """
     D = np.asarray(batch).shape[0]
     if dms is None:
         dms = np.zeros(D)
-    pp = _peak_plan(plan, tobs, **peak_kwargs)
-    outs, _ = _queue_stages_sharded(plan, batch, mesh)
-    snr_dev = _assemble_device(plan, *outs)
-    Dpad = snr_dev.shape[0]
-    dms_full = np.concatenate([np.asarray(dms, float), np.zeros(Dpad - D)])
-    peaks, polycos = device_find_peaks(pp, snr_dev, dms_full)
-    return peaks[:D], polycos[:D]
+    handle = queue_search_sharded(plan, batch, tobs, mesh=mesh, mode=mode,
+                                  **peak_kwargs)
+    return collect_search_sharded(handle, dms)

@@ -172,21 +172,33 @@ class BatchSearcher:
                 )
             for conf in self.range_confs:
                 plan = self._plan_for(conf, batch.shape[1], members[0].tsamp)
-                prepared = (
-                    None if self.mesh is not None
-                    else prepare_stage_data(plan, batch)
-                )
+                if self.mesh is not None:
+                    from ..parallel import prepare_stage_data_sharded
+
+                    prepared, _ = prepare_stage_data_sharded(
+                        plan, batch, self.mesh
+                    )
+                else:
+                    prepared = prepare_stage_data(plan, batch)
                 items.append((members, batch, conf, plan, prepared))
         return items
 
     def _ship_chunk(self, items):
         """Wire half of one chunk (runs on the dedicated ship thread):
-        start every prepared work item's host->device transfer."""
+        start every prepared work item's host->device transfer —
+        dm-sharded over the mesh when one is configured."""
         from ..search.engine import ship_stage_data
 
+        if self.mesh is not None:
+            from ..parallel import ship_stage_data_sharded
+
+            return [
+                (members, batch, conf, plan,
+                 ship_stage_data_sharded(plan, prepared, self.mesh))
+                for members, batch, conf, plan, prepared in items
+            ]
         return [
-            (members, batch, conf, plan,
-             None if prepared is None else ship_stage_data(plan, prepared))
+            (members, batch, conf, plan, ship_stage_data(plan, prepared))
             for members, batch, conf, plan, prepared in items
         ]
 
@@ -208,16 +220,23 @@ class BatchSearcher:
         fp_kwargs = conf.get("find_peaks", {})
         nreal = len(members)
         if self.mesh is not None:
-            from ..parallel import run_search_sharded
-
-            # The sharded path syncs internally (shard_map outputs are
-            # gathered per call); run it eagerly.
-            peaks_per_trial, _ = run_search_sharded(
-                plan, batch, tobs=tobs, dms=dms, mesh=self.mesh, **fp_kwargs
+            from ..parallel import (
+                collect_search_sharded, queue_search_sharded,
             )
-            return lambda: [
-                p for d in range(nreal) for p in peaks_per_trial[d]
-            ]
+
+            # Queue-ahead like the unsharded path: the whole sharded
+            # device side (wire decode, stages, fused peaks) enqueues
+            # without syncing; the collector pays the one round trip.
+            handle = queue_search_sharded(
+                plan, batch, tobs=tobs, mesh=self.mesh, shipped=shipped,
+                **fp_kwargs
+            )
+
+            def collect_mesh():
+                peaks_per_trial, _ = collect_search_sharded(handle, dms)
+                return [p for d in range(nreal) for p in peaks_per_trial[d]]
+
+            return collect_mesh
         handle = queue_search_batch(
             plan, batch, tobs=tobs, shipped=shipped, **fp_kwargs
         )

@@ -10,7 +10,7 @@ import struct
 
 import numpy as np
 
-INF_TEMPLATE = """\
+INF_COMMON = """\
  Data file name without suffix          =  {basename}
  Telescope used                         =  Parkes
  Instrument used                        =  Multibeam
@@ -22,7 +22,10 @@ INF_TEMPLATE = """\
  Barycentered?           (1=yes, 0=no)  =  1
  Number of bins in the time series      =  {nsamp}
  Width of each time series bin (sec)    =  {tsamp:.12e}
- Any breaks in the data? (1=yes, 0=no)  =  0
+ Any breaks in the data? (1=yes, 0=no)  =  {breaks}
+{onoff}"""
+
+INF_RADIO = """\
  Type of observation (EM band)          =  Radio
  Beam diameter (arcsec)                 =  981
  Dispersion measure (cm-3 pc)           =  {dm:.12f}
@@ -30,6 +33,19 @@ INF_TEMPLATE = """\
  Total bandwidth (Mhz)                  =  400
  Number of channels                     =  1024
  Channel bandwidth (Mhz)                =  0.390625
+ Data analyzed by                       =  Test Suite
+ Any additional notes:
+    Synthetic data written by the riptide_tpu test suite.
+"""
+
+# X-ray/Gamma .inf files replace the radio block with a photon-energy
+# block (riptide/reading/presto.py:112-116 parsing; fixture shape per
+# riptide/tests/data/README.md).
+INF_XRAY = """\
+ Type of observation (EM band)          =  {em_band}
+ Field-of-view diameter (arcsec)        =  981
+ Central energy (kev)                   =  1.0
+ Energy bandpass (kev)                  =  0.87
  Data analyzed by                       =  Test Suite
  Any additional notes:
     Synthetic data written by the riptide_tpu test suite.
@@ -49,16 +65,29 @@ def _pad_inf(text):
     return "\n".join(out) + "\n"
 
 
-def write_presto(outdir, basename, data, tsamp, dm=0.0):
+def write_presto(outdir, basename, data, tsamp, dm=0.0, onoff_pairs=(),
+                 em_band="Radio"):
     """Write a float32 array as a PRESTO .inf/.dat pair; returns the .inf
-    path."""
+    path. ``onoff_pairs`` adds 'Any breaks ... = 1' plus one 'On/Off bin
+    pair' line per pair; ``em_band`` of 'X-ray'/'Gamma' writes the
+    photon-energy header block instead of the radio one."""
     data = np.asarray(data, dtype=np.float32)
-    inf_text = _pad_inf(
-        INF_TEMPLATE.format(basename=basename, nsamp=data.size, tsamp=tsamp, dm=dm)
+    onoff = "".join(
+        f" On/Off bin pair #{i + 1:2d}                     "
+        f"=  {a}, {b}\n"
+        for i, (a, b) in enumerate(onoff_pairs)
     )
+    common = INF_COMMON.format(
+        basename=basename, nsamp=data.size, tsamp=tsamp,
+        breaks=1 if onoff_pairs else 0, onoff=onoff,
+    )
+    if em_band == "Radio":
+        tail = INF_RADIO.format(dm=dm)
+    else:
+        tail = INF_XRAY.format(em_band=em_band)
     inf_path = os.path.join(outdir, f"{basename}.inf")
     with open(inf_path, "w") as fobj:
-        fobj.write(inf_text)
+        fobj.write(_pad_inf(common + tail))
     data.tofile(os.path.join(outdir, f"{basename}.dat"))
     return inf_path
 

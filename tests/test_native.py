@@ -75,6 +75,25 @@ def test_downsample_matches_oracle():
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
+def test_rollback_matches_np_roll():
+    x = rng.standard_normal(97).astype(np.float32)
+    for shift in (0, 1, 13, 96, 97, 150, -5):
+        np.testing.assert_array_equal(
+            native.rollback(x, shift), np.roll(x, -shift)
+        )
+
+
+def test_fused_rollback_add_matches_composition():
+    x = rng.standard_normal(97).astype(np.float32)
+    y = rng.standard_normal(97).astype(np.float32)
+    for shift in (0, 1, 13, 96, 97, 150, -5):
+        np.testing.assert_array_equal(
+            native.fused_rollback_add(x, y, shift), x + np.roll(y, -shift)
+        )
+    with pytest.raises(ValueError):
+        native.fused_rollback_add(x, y[:-1], 1)
+
+
 def test_circular_prefix_sum_matches_oracle():
     x = rng.standard_normal(257).astype(np.float32)
     got = native.circular_prefix_sum(x, 400)

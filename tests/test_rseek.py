@@ -55,6 +55,34 @@ def test_rseek_sigproc_input(tmp_path):
     assert abs(top["snr"] - 18.5) < 0.15
 
 
+@pytest.mark.parametrize("signed", [False, True])
+def test_rseek_sigproc_8bit_input(tmp_path, signed):
+    """End-to-end search of 8-bit SIGPROC data (both signednesses): the
+    digitised fake pulsar must still come out on top at the oracle S/N
+    (8-bit digitisation at 1/16 sigma steps costs ~0.01 in S/N).
+    Mirrors the reference's 8-bit fixture coverage
+    (riptide/tests/test_time_series.py + data/README.md) at search
+    depth."""
+    np.random.seed(0)
+    from riptide_tpu import TimeSeries
+
+    ts = TimeSeries.generate(TOBS, TSAMP, PERIOD, amplitude=20.0,
+                             ducy=0.02, stdnoise=1.0)
+    q = np.rint(ts.data * 16.0)
+    if signed:
+        q = np.clip(q, -128, 127).astype(np.int8)
+    else:
+        q = np.clip(q + 128.0, 0, 255).astype(np.uint8)
+    fname = tmp_path / ("i8.tim" if signed else "u8.tim")
+    write_sigproc(fname, q, TSAMP, nbits=8, signed=signed, refdm=0.0)
+    df = _run(fname, "sigproc")
+    assert df is not None
+    top = df.iloc[0]
+    assert abs(top["freq"] - 1.0 / PERIOD) < 0.1 / TOBS
+    assert int(top["width"]) == 13
+    assert abs(top["snr"] - 18.5) < 0.15
+
+
 def test_rseek_pure_noise_returns_none(tmp_path, capsys):
     np.random.seed(42)
     noise = np.random.normal(size=int(32.0 / 1e-3)).astype(np.float32)

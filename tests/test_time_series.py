@@ -28,6 +28,53 @@ def test_from_presto(tmp_path):
     assert abs(ts.metadata["mjd"] - 59000.0) < 1e-9
 
 
+def test_from_presto_with_breaks(tmp_path):
+    """A .inf declaring breaks carries On/Off bin pairs between the
+    common block and the EM-band block; the parser must collect them and
+    still read the radio block that follows
+    (riptide/reading/presto.py:90-110, fixture per
+    riptide/tests/data/README.md and test_time_series.py:15-61)."""
+    from riptide_tpu.reading import PrestoInf
+
+    pairs = [(0, 7), (12, 15)]
+    inf = write_presto(str(tmp_path), "fix16_breaks", DATA16, TSAMP,
+                       dm=3.5, onoff_pairs=pairs)
+    hdr = PrestoInf(inf)
+    assert hdr["breaks"] is True
+    assert hdr["onoff_pairs"] == pairs
+    assert hdr["em_band"] == "Radio"
+    assert hdr["dm"] == 3.5
+    ts = TimeSeries.from_presto_inf(inf)
+    assert np.array_equal(ts.data, DATA16)
+    assert ts.metadata["dm"] == 3.5
+
+
+@pytest.mark.parametrize("em_band", ["X-ray", "Gamma"])
+def test_from_presto_xray_warns(tmp_path, em_band):
+    """X-ray/Gamma .inf files parse their photon-energy block and loading
+    them warns that the white-noise S/N assumption does not hold
+    (riptide/reading/presto.py:112-116, riptide/time_series.py:306-315)."""
+    from riptide_tpu.reading import PrestoInf
+
+    inf = write_presto(str(tmp_path), f"fix16_{em_band}", DATA16, TSAMP,
+                       em_band=em_band)
+    hdr = PrestoInf(inf)
+    assert hdr["em_band"] == em_band
+    assert hdr["central_energy_kev"] == 1.0
+    assert hdr["energy_bandpass_kev"] == 0.87
+    assert "dm" not in hdr
+    with pytest.warns(UserWarning, match="white noise"):
+        ts = TimeSeries.from_presto_inf(inf)
+    assert np.array_equal(ts.data, DATA16)
+
+
+def test_from_presto_unknown_band_rejected(tmp_path):
+    inf = write_presto(str(tmp_path), "fix16_bad", DATA16, TSAMP,
+                       em_band="Neutrino")
+    with pytest.raises(ValueError, match="EM Band"):
+        TimeSeries.from_presto_inf(inf)
+
+
 def test_from_sigproc_float32(tmp_path):
     path = write_sigproc(str(tmp_path / "f32.tim"), DATA16, TSAMP, nbits=32, refdm=7.0)
     ts = TimeSeries.from_sigproc(path)
