@@ -292,6 +292,8 @@ def rollback(data, shift):
     (riptide/cpp/python_bindings.cpp:32-44)."""
     lib = _require()
     data = np.ascontiguousarray(data, np.float32)
+    if data.size == 0:
+        raise ValueError("rollback requires a non-empty array")
     out = np.empty_like(data)
     lib.rn_rollback(data, data.size, int(shift), out)
     return out
@@ -306,6 +308,8 @@ def fused_rollback_add(x, y, shift):
     y = np.ascontiguousarray(y, np.float32)
     if x.shape != y.shape:
         raise ValueError("x and y must have the same shape")
+    if x.size == 0:
+        raise ValueError("fused_rollback_add requires non-empty arrays")
     out = np.empty_like(x)
     lib.rn_fused_rollback_add(x, y, x.size, int(shift), out)
     return out

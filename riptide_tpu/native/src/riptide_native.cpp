@@ -349,11 +349,25 @@ static void f32_to_f16_vec(const float* in, uint16_t* out, int64_t n) {
     for (int64_t i = 0; i < n; ++i) out[i] = f32_to_f16_rne(in[i]);
 }
 
+// Anchored-float32 prefix storage parameters (see prefix_scan4 below).
+static const int64_t ANCHOR_LOG = 12;
+static const int64_t ANCHOR_BLK = int64_t(1) << ANCHOR_LOG;  // 4096
+
+// Reconstruct the float64 prefix at index j from float32 residuals +
+// per-block float64 anchors (see prefix_scan4).
+static inline double cs_at(const float* c, const double* anchors,
+                           int64_t j) {
+    const int64_t g = (j > 0 ? j - 1 : 0) >> ANCHOR_LOG;
+    return anchors[g] + double(c[j]);
+}
+
 // One stage's downsampled values (the real-factor window sums) plus the
 // running max|v|. The float64 operation order matches the scalar path
-// exactly: (w0*x[a] + wi*(c[b]-c[a+1])) + w1*x[b], no FMA contraction,
-// so scalar/AVX2/numpy-fallback all produce identical bytes.
-static void stage_values_scalar(const float* x, const double* c,
+// exactly: (w0*x[a] + wi*(cs(b)-cs(a+1))) + w1*x[b] with cs(j) =
+// anchors[g(j)] + double(c32[j]), no FMA contraction, so
+// scalar/AVX2/numpy-fallback all produce identical bytes.
+static void stage_values_scalar(const float* x, const float* c,
+                                const double* anchors,
                                 const int32_t* a, const int32_t* b,
                                 const float* w0, const float* w1,
                                 const float* wi, float* out, int64_t n,
@@ -361,7 +375,8 @@ static void stage_values_scalar(const float* x, const double* c,
     float vm = *vmax_io;
     for (int64_t k = 0; k < n; ++k) {
         const double v = double(w0[k]) * x[a[k]]
-            + double(wi[k]) * (c[b[k]] - c[a[k] + 1])
+            + double(wi[k]) * (cs_at(c, anchors, b[k])
+                               - cs_at(c, anchors, a[k] + 1))
             + double(w1[k]) * x[b[k]];
         const float vf = static_cast<float>(v);
         out[k] = vf;
@@ -373,13 +388,16 @@ static void stage_values_scalar(const float* x, const double* c,
 
 #if defined(__x86_64__)
 __attribute__((target("avx2")))
-static void stage_values_avx2(const float* x, const double* c,
+static void stage_values_avx2(const float* x, const float* c,
+                              const double* anchors,
                               const int32_t* a, const int32_t* b,
                               const float* w0, const float* w1,
                               const float* wi, float* out, int64_t n,
                               float* vmax_io) {
     const __m256 abs_mask =
         _mm256_castsi256_ps(_mm256_set1_epi32(0x7FFFFFFF));
+    const __m256i one = _mm256_set1_epi32(1);
+    const __m256i izero = _mm256_setzero_si256();
     __m256 vmax8 = _mm256_setzero_ps();
     int64_t k = 0;
     for (; k + 8 <= n; k += 8) {
@@ -389,14 +407,30 @@ static void stage_values_avx2(const float* x, const double* c,
             _mm256_loadu_si256(reinterpret_cast<const __m256i*>(b + k));
         const __m256 xa = _mm256_i32gather_ps(x, ai, 4);
         const __m256 xb = _mm256_i32gather_ps(x, bi, 4);
-        const __m128i alo = _mm256_castsi256_si128(ai);
-        const __m128i ahi = _mm256_extracti128_si256(ai, 1);
-        const __m128i blo = _mm256_castsi256_si128(bi);
-        const __m128i bhi = _mm256_extracti128_si256(bi, 1);
-        const __m256d ca_lo = _mm256_i32gather_pd(c + 1, alo, 8);
-        const __m256d ca_hi = _mm256_i32gather_pd(c + 1, ahi, 8);
-        const __m256d cb_lo = _mm256_i32gather_pd(c, blo, 8);
-        const __m256d cb_hi = _mm256_i32gather_pd(c, bhi, 8);
+        // f32 residual gathers: c[a+1] (base c+1, index a) and c[b].
+        const __m256 ra = _mm256_i32gather_ps(c + 1, ai, 4);
+        const __m256 rb = _mm256_i32gather_ps(c, bi, 4);
+        // anchor indices: g(a+1) = a >> LOG, g(b) = max(b-1, 0) >> LOG
+        const __m256i ga = _mm256_srli_epi32(ai, ANCHOR_LOG);
+        const __m256i gb = _mm256_srli_epi32(
+            _mm256_max_epi32(_mm256_sub_epi32(bi, one), izero), ANCHOR_LOG);
+        const __m256d aa_lo =
+            _mm256_i32gather_pd(anchors, _mm256_castsi256_si128(ga), 8);
+        const __m256d aa_hi =
+            _mm256_i32gather_pd(anchors, _mm256_extracti128_si256(ga, 1), 8);
+        const __m256d ab_lo =
+            _mm256_i32gather_pd(anchors, _mm256_castsi256_si128(gb), 8);
+        const __m256d ab_hi =
+            _mm256_i32gather_pd(anchors, _mm256_extracti128_si256(gb, 1), 8);
+        // cs(a+1) = anchor + double(residual); likewise cs(b).
+        const __m256d ca_lo = _mm256_add_pd(
+            aa_lo, _mm256_cvtps_pd(_mm256_castps256_ps128(ra)));
+        const __m256d ca_hi = _mm256_add_pd(
+            aa_hi, _mm256_cvtps_pd(_mm256_extractf128_ps(ra, 1)));
+        const __m256d cb_lo = _mm256_add_pd(
+            ab_lo, _mm256_cvtps_pd(_mm256_castps256_ps128(rb)));
+        const __m256d cb_hi = _mm256_add_pd(
+            ab_hi, _mm256_cvtps_pd(_mm256_extractf128_ps(rb, 1)));
         const __m256 w0v = _mm256_loadu_ps(w0 + k);
         const __m256 w1v = _mm256_loadu_ps(w1 + k);
         const __m256 wiv = _mm256_loadu_ps(wi + k);
@@ -434,7 +468,8 @@ static void stage_values_avx2(const float* x, const double* c,
     for (int i = 0; i < 8; ++i) vm = tmp[i] > vm ? tmp[i] : vm;
     for (; k < n; ++k) {
         const double v = double(w0[k]) * x[a[k]]
-            + double(wi[k]) * (c[b[k]] - c[a[k] + 1])
+            + double(wi[k]) * (cs_at(c, anchors, b[k])
+                               - cs_at(c, anchors, a[k] + 1))
             + double(w1[k]) * x[b[k]];
         const float vf = static_cast<float>(v);
         out[k] = vf;
@@ -448,39 +483,57 @@ static bool avx2_supported() {
 }
 #else
 static bool avx2_supported() { return false; }
-static void stage_values_avx2(const float*, const double*, const int32_t*,
-                              const int32_t*, const float*, const float*,
-                              const float*, float*, int64_t, float*) {}
+static void stage_values_avx2(const float*, const float*, const double*,
+                              const int32_t*, const int32_t*, const float*,
+                              const float*, const float*, float*, int64_t,
+                              float*) {}
 #endif
 
-static void stage_values(const float* x, const double* c, const int32_t* a,
+static void stage_values(const float* x, const float* c,
+                         const double* anchors, const int32_t* a,
                          const int32_t* b, const float* w0, const float* w1,
                          const float* wi, float* out, int64_t n,
                          float* vmax_io) {
     if (avx2_supported()) {
-        stage_values_avx2(x, c, a, b, w0, w1, wi, out, n, vmax_io);
+        stage_values_avx2(x, c, anchors, a, b, w0, w1, wi, out, n, vmax_io);
         return;
     }
-    stage_values_scalar(x, c, a, b, w0, w1, wi, out, n, vmax_io);
+    stage_values_scalar(x, c, anchors, a, b, w0, w1, wi, out, n, vmax_io);
 }
 
-// One trial's float64 prefix sum in the 4-lane vector-scan order
-// shared bit-for-bit with the numpy fallback (search/engine.py
-// `_prefix64`): elements are processed in groups of 4 with lane sums
+// One trial's prefix sum in the 4-lane vector-scan order shared
+// bit-for-bit with the numpy fallback (search/engine.py `_prefix64` /
+// `_prefix_anchored`): elements are processed in groups of 4 with lane
+// sums
 //   l = [x0, x1+x0, (x2+x1)+x0, (x3+x2)+(x1+x0)]
 // then cs[4v+1..4v+4] = carry + l and carry = cs[4v+4]; the <4-element
 // tail continues serially from carry. A strictly serial accumulator is
 // latency-bound (one dependent f64 add per element); this order's
 // serial chain is one add per FOUR elements, the rest is lane-parallel
 // (and AVX2-vectorized below), for ~4x on the survey's host hot path.
-// The association change is ~1 ulp in float64 — far below the wire
-// quantisation — but both implementations must share it exactly so the
-// native-vs-numpy byte-parity tests stay deterministic.
+//
+// STORAGE is the anchored-float32 form: the exact float64 running sum
+// is never materialised — every prefix value is stored as the float32
+// RESIDUAL against its block's float64 anchor, with one anchor per
+// ANCHOR_BLK samples (anchors[g] = exact cs at sample g * ANCHOR_BLK).
+// Consumers reconstruct cs64(j) = anchors[(j-1) >> ANCHOR_LOG] +
+// double(c[j]) (j = 0 -> 0). Residuals stay below ~ANCHOR_BLK * |x|,
+// so the f32 representation error is <= ~1e-5 absolute — far below the
+// wire quantisation — while the prefix pass writes HALF the bytes of a
+// float64 array (this pass is memory-bound and was the largest single
+// host cost of a survey chunk). The f64 carry chain itself is
+// unchanged, and the numpy fallback rounds the identical f64 values
+// the same way, so native/numpy wire bytes stay bit-identical.
+// (ANCHOR_LOG/ANCHOR_BLK and cs_at are defined above stage_values.)
 #if defined(__x86_64__)
+// One <=ANCHOR_BLK block's groups-of-4: writes float32 residuals
+// against `anchor`, returns the f64 carry after the block.
 __attribute__((target("avx2")))
-static double prefix_scan4_avx2(const float* x, int64_t nv, double* c) {
+static double block_scan4_avx2(const float* x, int64_t nv, float* c1,
+                               double anchor, double carry) {
     const __m256d zero = _mm256_setzero_pd();
-    __m256d vcarry = _mm256_setzero_pd();
+    const __m256d anc = _mm256_set1_pd(anchor);
+    __m256d vcarry = _mm256_set1_pd(carry);
     for (int64_t v = 0; v < nv; ++v) {
         const int64_t i = 4 * v;
         __m256d xv = _mm256_cvtps_pd(_mm_loadu_ps(x + i));
@@ -493,7 +546,7 @@ static double prefix_scan4_avx2(const float* x, int64_t nv, double* c) {
         sh2 = _mm256_blend_pd(sh2, zero, 0x3);
         __m256d s2 = _mm256_add_pd(s1, sh2);
         __m256d out = _mm256_add_pd(s2, vcarry);
-        _mm256_storeu_pd(c + i + 1, out);
+        _mm_storeu_ps(c1 + i, _mm256_cvtpd_ps(_mm256_sub_pd(out, anc)));
         // carry = out lane 3, broadcast
         vcarry = _mm256_permute4x64_pd(out, _MM_SHUFFLE(3, 3, 3, 3));
     }
@@ -501,44 +554,59 @@ static double prefix_scan4_avx2(const float* x, int64_t nv, double* c) {
 }
 #endif
 
-static void prefix_scan4(const float* x, int64_t N, double* c) {
-    c[0] = 0.0;
+static void prefix_scan4(const float* x, int64_t N, float* c,
+                         double* anchors) {
+    c[0] = 0.0f;
     double carry = 0.0;
-    const int64_t nv = N / 4;
-    int64_t i = 4 * nv;
+    int64_t i = 0;
+    int64_t g = 0;
+    while (i < N) {
+        const double anchor = carry;
+        anchors[g++] = anchor;
+        const int64_t end = std::min(N, i + ANCHOR_BLK);
+        const int64_t nv = (end - i) / 4;  // block length % 4 only at N
 #if defined(__x86_64__)
-    if (avx2_supported()) {
-        carry = prefix_scan4_avx2(x, nv, c);
-    } else
+        if (avx2_supported() && nv) {
+            carry = block_scan4_avx2(x + i, nv, c + i + 1, anchor, carry);
+        } else
 #endif
-    {
-        for (int64_t v = 0; v < nv; ++v) {
-            const int64_t j = 4 * v;
-            const double x0 = x[j], x1 = x[j + 1], x2 = x[j + 2], x3 = x[j + 3];
-            const double l1 = x1 + x0;
-            const double l2 = (x2 + x1) + x0;
-            const double l3 = (x3 + x2) + l1;
-            c[j + 1] = carry + x0;
-            c[j + 2] = carry + l1;
-            c[j + 3] = carry + l2;
-            c[j + 4] = carry + l3;
-            carry = c[j + 4];
+        {
+            for (int64_t v = 0; v < nv; ++v) {
+                const int64_t j = i + 4 * v;
+                const double x0 = x[j], x1 = x[j + 1], x2 = x[j + 2],
+                             x3 = x[j + 3];
+                const double l1 = x1 + x0;
+                const double l2 = (x2 + x1) + x0;
+                const double l3 = (x3 + x2) + l1;
+                c[j + 1] = static_cast<float>((carry + x0) - anchor);
+                c[j + 2] = static_cast<float>((carry + l1) - anchor);
+                c[j + 3] = static_cast<float>((carry + l2) - anchor);
+                carry = carry + l3;
+                c[j + 4] = static_cast<float>(carry - anchor);
+            }
         }
+        for (int64_t j = i + 4 * nv; j < end; ++j) {
+            carry += x[j];
+            c[j + 1] = static_cast<float>(carry - anchor);
+        }
+        i = end;
     }
-    for (; i < N; ++i) { carry += x[i]; c[i + 1] = carry; }
 }
 
-// Per-trial float64 prefix sums of a (D, N) batch, threaded over trials
-// (shared by the wire-preparation entry points).
+// Per-trial anchored prefix sums of a (D, N) batch, threaded over
+// trials (shared by the wire-preparation entry points). anchors holds
+// G = ceil(N / ANCHOR_BLK) doubles per trial.
 static void batch_prefix_sums(const float* batch, int64_t D, int64_t N,
-                              double* cs, int64_t nthreads) {
+                              float* cs, double* anchors, int64_t G,
+                              int64_t nthreads) {
     std::vector<std::thread> pool;
     std::atomic<int64_t> next_d(0);
     for (int64_t t = 0; t < std::min<int64_t>(nthreads, D); ++t) {
         pool.emplace_back([&]() {
             int64_t d;
             while ((d = next_d.fetch_add(1)) < D) {
-                prefix_scan4(batch + d * N, N, cs + d * (N + 1));
+                prefix_scan4(batch + d * N, N, cs + d * (N + 1),
+                             anchors + d * G);
             }
         });
     }
@@ -550,10 +618,12 @@ void rn_downsample_stages(const float* batch, int64_t D, int64_t N,
                           const float* wmin, const float* wmax,
                           const float* wint, int64_t S, int64_t nout,
                           int64_t nthreads, int as_f16, void* out) {
-    std::vector<double> cs((N + 1) * D);
+    const int64_t G = (N + ANCHOR_BLK - 1) / ANCHOR_BLK;
+    std::vector<float> cs((N + 1) * D);
+    std::vector<double> anchors(G * D);
     std::vector<std::thread> pool;
     if (nthreads <= 0) nthreads = 1;
-    batch_prefix_sums(batch, D, N, cs.data(), nthreads);
+    batch_prefix_sums(batch, D, N, cs.data(), anchors.data(), G, nthreads);
     // phase 2: stages x trials
     std::atomic<int64_t> next_job(0);
     const int64_t njobs = S * D;
@@ -564,7 +634,8 @@ void rn_downsample_stages(const float* batch, int64_t D, int64_t N,
             while ((job = next_job.fetch_add(1)) < njobs) {
                 const int64_t s = job / D, d = job % D;
                 const float* x = batch + d * N;
-                const double* c = cs.data() + d * (N + 1);
+                const float* c = cs.data() + d * (N + 1);
+                const double* anc = anchors.data() + d * G;
                 const int32_t* a = imin + s * nout;
                 const int32_t* b = imax + s * nout;
                 const float* w0 = wmin + s * nout;
@@ -576,7 +647,8 @@ void rn_downsample_stages(const float* batch, int64_t D, int64_t N,
                     scratch.resize(nout);
                     for (int64_t k = 0; k < nout; ++k) {
                         const double v = double(w0[k]) * x[a[k]]
-                            + double(wi[k]) * (c[b[k]] - c[a[k] + 1])
+                            + double(wi[k]) * (cs_at(c, anc, b[k])
+                               - cs_at(c, anc, a[k] + 1))
                             + double(w1[k]) * x[b[k]];
                         scratch[k] = static_cast<float>(v);
                     }
@@ -585,7 +657,8 @@ void rn_downsample_stages(const float* batch, int64_t D, int64_t N,
                     float* o = static_cast<float*>(out) + base;
                     for (int64_t k = 0; k < nout; ++k) {
                         const double v = double(w0[k]) * x[a[k]]
-                            + double(wi[k]) * (c[b[k]] - c[a[k] + 1])
+                            + double(wi[k]) * (cs_at(c, anc, b[k])
+                               - cs_at(c, anc, a[k] + 1))
                             + double(w1[k]) * x[b[k]];
                         o[k] = static_cast<float>(v);
                     }
@@ -613,10 +686,12 @@ void rn_prepare_wire_u12(const float* batch, int64_t D, int64_t N,
                          const int32_t* nouts, const int64_t* boffs,
                          int64_t totbytes, int64_t nthreads,
                          float* scales, uint8_t* out) {
-    std::vector<double> cs((N + 1) * D);
+    const int64_t G = (N + ANCHOR_BLK - 1) / ANCHOR_BLK;
+    std::vector<float> cs((N + 1) * D);
+    std::vector<double> anchors(G * D);
     std::vector<std::thread> pool;
     if (nthreads <= 0) nthreads = 1;
-    batch_prefix_sums(batch, D, N, cs.data(), nthreads);
+    batch_prefix_sums(batch, D, N, cs.data(), anchors.data(), G, nthreads);
     std::atomic<int64_t> next_job(0);
     const int64_t njobs = S * D;
     for (int64_t t = 0; t < std::min<int64_t>(nthreads, njobs); ++t) {
@@ -626,7 +701,8 @@ void rn_prepare_wire_u12(const float* batch, int64_t D, int64_t N,
             while ((job = next_job.fetch_add(1)) < njobs) {
                 const int64_t s = job / D, d = job % D;
                 const float* x = batch + d * N;
-                const double* c = cs.data() + d * (N + 1);
+                const float* c = cs.data() + d * (N + 1);
+                const double* anc = anchors.data() + d * G;
                 const int32_t* a = imin + s * nout_pad;
                 const int32_t* b = imax + s * nout_pad;
                 const float* w0 = wmin + s * nout_pad;
@@ -635,7 +711,7 @@ void rn_prepare_wire_u12(const float* batch, int64_t D, int64_t N,
                 const int64_t n = nouts[s];
                 scratch.resize(n + 1);
                 float vmax = 0.0f;
-                stage_values(x, c, a, b, w0, w1, wi, scratch.data(), n,
+                stage_values(x, c, anc, a, b, w0, w1, wi, scratch.data(), n,
                              &vmax);
                 scratch[n] = 0.0f;  // pad sample for odd n
                 const float scale = vmax > 0.0f ? vmax / 2047.0f : 1.0f;
@@ -677,10 +753,12 @@ void rn_prepare_wire_u6(const float* batch, int64_t D, int64_t N,
                         int64_t totbytes, const int64_t* soffs,
                         int64_t totscales, int64_t blkq, int64_t nthreads,
                         float* scales, uint8_t* out) {
-    std::vector<double> cs((N + 1) * D);
+    const int64_t G = (N + ANCHOR_BLK - 1) / ANCHOR_BLK;
+    std::vector<float> cs((N + 1) * D);
+    std::vector<double> anchors(G * D);
     std::vector<std::thread> pool;
     if (nthreads <= 0) nthreads = 1;
-    batch_prefix_sums(batch, D, N, cs.data(), nthreads);
+    batch_prefix_sums(batch, D, N, cs.data(), anchors.data(), G, nthreads);
     std::atomic<int64_t> next_job(0);
     const int64_t njobs = S * D;
     for (int64_t t = 0; t < std::min<int64_t>(nthreads, njobs); ++t) {
@@ -690,7 +768,8 @@ void rn_prepare_wire_u6(const float* batch, int64_t D, int64_t N,
             while ((job = next_job.fetch_add(1)) < njobs) {
                 const int64_t s = job / D, d = job % D;
                 const float* x = batch + d * N;
-                const double* c = cs.data() + d * (N + 1);
+                const float* c = cs.data() + d * (N + 1);
+                const double* anc = anchors.data() + d * G;
                 const int32_t* a = imin + s * nout_pad;
                 const int32_t* b = imax + s * nout_pad;
                 const float* w0 = wmin + s * nout_pad;
@@ -700,7 +779,7 @@ void rn_prepare_wire_u6(const float* batch, int64_t D, int64_t N,
                 const int64_t nblk = (n + blkq - 1) / blkq;
                 scratch.resize(nblk * blkq);
                 float vmax_unused = 0.0f;
-                stage_values(x, c, a, b, w0, w1, wi, scratch.data(), n,
+                stage_values(x, c, anc, a, b, w0, w1, wi, scratch.data(), n,
                              &vmax_unused);
                 for (int64_t k = n; k < nblk * blkq; ++k) scratch[k] = 0.0f;
                 float* sc = scales + d * totscales + soffs[s];
@@ -751,10 +830,12 @@ void rn_prepare_wire_u8(const float* batch, int64_t D, int64_t N,
                         int64_t totbytes, const int64_t* soffs,
                         int64_t totscales, int64_t blkq, int64_t nthreads,
                         float* scales, uint8_t* out) {
-    std::vector<double> cs((N + 1) * D);
+    const int64_t G = (N + ANCHOR_BLK - 1) / ANCHOR_BLK;
+    std::vector<float> cs((N + 1) * D);
+    std::vector<double> anchors(G * D);
     std::vector<std::thread> pool;
     if (nthreads <= 0) nthreads = 1;
-    batch_prefix_sums(batch, D, N, cs.data(), nthreads);
+    batch_prefix_sums(batch, D, N, cs.data(), anchors.data(), G, nthreads);
     std::atomic<int64_t> next_job(0);
     const int64_t njobs = S * D;
     for (int64_t t = 0; t < std::min<int64_t>(nthreads, njobs); ++t) {
@@ -764,7 +845,8 @@ void rn_prepare_wire_u8(const float* batch, int64_t D, int64_t N,
             while ((job = next_job.fetch_add(1)) < njobs) {
                 const int64_t s = job / D, d = job % D;
                 const float* x = batch + d * N;
-                const double* c = cs.data() + d * (N + 1);
+                const float* c = cs.data() + d * (N + 1);
+                const double* anc = anchors.data() + d * G;
                 const int32_t* a = imin + s * nout_pad;
                 const int32_t* b = imax + s * nout_pad;
                 const float* w0 = wmin + s * nout_pad;
@@ -774,7 +856,7 @@ void rn_prepare_wire_u8(const float* batch, int64_t D, int64_t N,
                 const int64_t nblk = (n + blkq - 1) / blkq;
                 scratch.resize(nblk * blkq);
                 float vmax_unused = 0.0f;
-                stage_values(x, c, a, b, w0, w1, wi, scratch.data(), n,
+                stage_values(x, c, anc, a, b, w0, w1, wi, scratch.data(), n,
                              &vmax_unused);
                 for (int64_t k = n; k < nblk * blkq; ++k) scratch[k] = 0.0f;
                 float* sc = scales + d * totscales + soffs[s];

@@ -202,9 +202,9 @@ def _queue_stages_sharded(plan, batch, mesh, shipped=None, mode=None):
         prepared, D = prepare_stage_data_sharded(plan, batch, mesh, mode=mode)
         shipped = ship_stage_data_sharded(plan, prepared, mesh)
     else:
-        D = shipped[1].get("D_original")
-        if D is None:
-            D = shipped[0].shape[0]
+        # meta["D_original"] is set by prepare_stage_data_sharded — the
+        # one source of truth for the unpadded trial count.
+        D = shipped[1]["D_original"]
     flat_dev, meta = shipped
     outs = []
     for i, st in enumerate(plan.stages):

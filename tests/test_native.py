@@ -136,14 +136,15 @@ def test_downsample_stages_matches_numpy():
     """Threaded all-stages batch downsample == the numpy reference path,
     bit-exactly, in both float32 and float16 wire dtypes."""
     from riptide_tpu.search.engine import (
-        _ds_pack, _prefix64, _stage_downsample,
+        _ds_pack, _prefix_anchored, _stage_downsample,
     )
     from riptide_tpu.search.plan import periodogram_plan
 
     plan = periodogram_plan(1 << 16, 1e-3, (1, 2, 3), 64e-3, 2.0, 64, 71)
     batch = rng.standard_normal((3, 1 << 16)).astype(np.float32)
-    d64, cs = _prefix64(batch)
-    want = np.stack([_stage_downsample(st, d64, cs) for st in plan.stages])
+    d64, c32, anchors = _prefix_anchored(batch)
+    want = np.stack([_stage_downsample(st, d64, c32, anchors)
+                     for st in plan.stages])
 
     imin, imax, wmin, wmax, wint = _ds_pack(plan)
     got32 = native.downsample_stages(batch, imin, imax, wmin, wmax, wint,
@@ -159,15 +160,16 @@ def test_downsample_stages_matches_numpy_ragged_n():
     vector-to-tail carry handoff; native and numpy must still agree
     byte-for-byte."""
     from riptide_tpu.search.engine import (
-        _ds_pack, _prefix64, _stage_downsample,
+        _ds_pack, _prefix_anchored, _stage_downsample,
     )
     from riptide_tpu.search.plan import periodogram_plan
 
     n = (1 << 16) + 3
     plan = periodogram_plan(n, 1e-3, (1, 2, 3), 64e-3, 2.0, 64, 71)
     batch = rng.standard_normal((2, n)).astype(np.float32)
-    d64, cs = _prefix64(batch)
-    want = np.stack([_stage_downsample(st, d64, cs) for st in plan.stages])
+    d64, c32, anchors = _prefix_anchored(batch)
+    want = np.stack([_stage_downsample(st, d64, c32, anchors)
+                     for st in plan.stages])
     imin, imax, wmin, wmax, wint = _ds_pack(plan)
     got = native.downsample_stages(batch, imin, imax, wmin, wmax, wint,
                                    dtype=np.float32)
