@@ -1,0 +1,73 @@
+"""Per-bucket device timing of the headline plan's cycle kernels.
+
+Times each distinct compiled kernel bucket at the production D (data
+already resident in HBM, repeated calls, one fetch at the end), giving
+the device-only decomposition of a survey chunk: sum of per-bucket
+times x stages-per-bucket ~= the chunk's pure kernel time, excluding
+wire/pack/assemble/peaks. Usage: python tools/dtime.py [D] [reps]
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/riptide_tpu_jax_cache")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(D=32, reps=6):
+    from riptide_tpu.ffautils import generate_width_trials
+    from riptide_tpu.search import periodogram_plan
+    from riptide_tpu.search.engine import _kernel_eligible, warm_stage_kernels
+
+    widths = tuple(int(w) for w in generate_width_trials(240))
+    plan = periodogram_plan(1 << 23, 64e-6, widths, 0.5, 3.0, 240, 260)
+    t0 = time.perf_counter()
+    warm_stage_kernels(plan, D)
+    print(f"warm: {time.perf_counter() - t0:.1f}s", flush=True)
+
+    rng = np.random.default_rng(0)
+    seen = {}
+    stages_per = {}
+    for st in plan.stages:
+        if not _kernel_eligible(st, plan):
+            print(f"stage n={st.n}: NOT kernel-eligible", flush=True)
+            continue
+        kern = st.cycle_kernel()
+        key = (kern.L, kern.rows, kern.P, kern.B)
+        stages_per[key] = stages_per.get(key, 0) + 1
+        seen.setdefault(key, kern)
+
+    total = 0.0
+    for key, kern in seen.items():
+        L, rows, P, B = key
+        x = jnp.asarray(rng.standard_normal(
+            (D, B, rows, P)).astype(np.float32))
+        # warm + sync (a real fetch; block_until_ready does not sync
+        # through the tunnel)
+        float(np.asarray(kern(x)[0, 0, 0, 0]))
+
+        def run(n):
+            t0 = time.perf_counter()
+            outs = [kern(x)[0, 0, 0, 0] for _ in range(n)]
+            float(np.asarray(jnp.stack(outs).sum()))
+            return time.perf_counter() - t0
+
+        r1, r2 = 2, 2 + reps
+        dt = (min(run(r2) for _ in range(2)) - min(run(r1) for _ in range(2))) / (r2 - r1)
+        total += dt * stages_per[key]
+        print(f"bucket L={L} rows={rows} P={P} B={B} x{stages_per[key]} "
+              f"stages: {dt * 1e3:.1f} ms/call -> "
+              f"{dt * stages_per[key]:.3f} s for its stages", flush=True)
+    print(f"device kernel total per {D}-trial chunk: {total:.2f} s "
+          f"({D / total:.1f} trials/s kernel-only bound)", flush=True)
+
+
+if __name__ == "__main__":
+    D = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+    main(D, reps)
