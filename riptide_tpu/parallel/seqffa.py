@@ -18,20 +18,35 @@ followed by a local gather+roll+add of each shard's output rows, so
 compute stays fully sharded and only the folded buffer (m x p floats per
 level) rides the interconnect.
 
-**Scope — a deliberate demo of the decomposition, not a production
-path.** Sizing: the flagship survey config folds 2^23-sample series —
-32 MB of float32 — and the largest per-cycle fold container is
-(2048 rows x 384 padded bins x 21 bins-trials x 4 B) ~ 66 MB, against
-16 GB of HBM per v5e chip: real searches are ~200x below the point
-where one transform must span chips, which is why the production layout
-(:mod:`riptide_tpu.parallel.sharded`) shards the DM batch and keeps
-every series chip-local (SURVEY §5 long-context analysis reaches the
-same conclusion). The per-level full ``all_gather`` here moves
-log2(S) * m * p floats per shard where a windowed pairwise exchange
-would move (m/S) * log2(S); acceptable for a demo, wasteful at scale —
-if observations ever outgrow HBM, replace the gather with per-level
-``ppermute`` of the two ~m_local/2-row source windows each shard's
-outputs actually read (the h/t tables below already bound them).
+Two cross-level exchanges exist:
+
+* ``all_gather`` (S < 8, or when a window check fails): every shard
+  gathers the full (m, p) buffer per level — optimal at tiny S, the
+  simplest correct form.
+* **windowed ppermute** (S >= 8, the production path): each shard's
+  output rows at a cross level read a contiguous ~m_local/2-row window
+  of the head half and one of the tail half of its merge node — the
+  h/t level tables bound both windows EXACTLY, host-side. Each window
+  spans at most two source shards, so four ``ppermute`` s (deduplicated
+  when windows fit one shard) deliver everything a shard reads:
+  <= 4 * m_local * p floats received per shard per level instead of
+  all_gather's (S-1) * m_local * p — the communication scales with the
+  SHARD size, not the sequence, so doubling the chips halves both the
+  per-chip compute and the per-chip bytes. Collectives ride the ICI
+  ring as neighbour-biased permutes.
+
+Sizing context: the flagship survey config folds 2^23-sample series —
+32 MB of float32 — against 16 GB of HBM per v5e chip, so real searches
+are ~200x below the point where one transform must span chips; the
+production layout (:mod:`riptide_tpu.parallel.sharded`) therefore
+shards the DM batch (SURVEY §5 reaches the same conclusion). This
+module is for the regime beyond that point (very long observations
+folded at short periods), and the windowed exchange keeps it scalable
+there.
+
+The shard count must be a power of two: the FFA tree splits in halves,
+so node boundaries align to shard boundaries only for power-of-two S
+(m_local itself may be any size; non-power-of-2 m works).
 """
 from functools import lru_cache
 
@@ -76,22 +91,91 @@ def _cross_tables(m, S):
     )
 
 
-def _cross_level(y, h, t, shift, p, axis):
+def _merge_rows(buf, h, t, shift):
+    """The merge arithmetic shared by both exchange forms: out =
+    buf[h] + roll(buf[t], -shift) per output row."""
+    P = buf.shape[1]
+    head = buf[h]
+    tail = buf[t]
+    cols = jnp.arange(P, dtype=jnp.int32)[None, :]
+    idx = (cols + shift[:, None]) % P
+    return head + jnp.take_along_axis(tail, idx, axis=1)
+
+
+def _cross_level(y, h, t, shift, axis):
     """
-    One cross-shard merge level.
+    One cross-shard merge level (all_gather form).
 
     y : (m_local, p) this shard's current rows
     h, t, shift : (m_local,) int32 — global row ids / shift of this
         shard's output rows at this level
     """
-    m_local, P = y.shape
+    P = y.shape[1]
     full = jax.lax.all_gather(y, axis, axis=0, tiled=True)  # (m, p)
     full = jnp.concatenate([full, jnp.zeros((1, P), full.dtype)])  # zero row
-    head = full[h]
-    tail = full[t]
-    cols = jnp.arange(P, dtype=jnp.int32)[None, :]
-    idx = (cols + shift[:, None]) % P
-    return head + jnp.take_along_axis(tail, idx, axis=1)
+    return _merge_rows(full, h, t, shift)
+
+
+@lru_cache(maxsize=64)
+def _window_plan(m, S):
+    """Static plan of the windowed-ppermute exchange.
+
+    For every cross level, computes from the ACTUAL level tables (no
+    estimation) the <= 2 source shards of each destination shard's head
+    window and tail window, and rewrites the global row ids into local
+    indices of the per-shard receive buffer
+    ``concat(recv_h0, recv_h1, recv_t0, recv_t1, zero_row)``.
+
+    Returns a list over cross levels of
+    ``(perms (4, S) int, hloc (S, m_local), tloc (S, m_local),
+    shift (S, m_local))``, or None when some window spans more than two
+    shards (m_local too small for the window bound) — callers then fall
+    back to the all_gather form.
+    """
+    m_local = m // S
+    gplan = ffa_plan(m)
+    L_local = num_levels(m_local)
+    h = gplan.h[L_local:, :m]
+    t = gplan.t[L_local:, :m]
+    shift = gplan.shift[L_local:, :m]
+    Z = m  # the plan's zero-row id
+    levels = []
+    for lvl in range(h.shape[0]):
+        hs = h[lvl].reshape(S, m_local)
+        ts = t[lvl].reshape(S, m_local)
+        sh = shift[lvl].reshape(S, m_local)
+        perms = np.zeros((4, S), np.int32)
+        hloc = np.zeros((S, m_local), np.int32)
+        tloc = np.zeros((S, m_local), np.int32)
+        for k in range(S):
+            for w, (ids, out) in enumerate(((hs[k], hloc), (ts[k], tloc))):
+                real = ids[ids != Z]
+                if real.size == 0:
+                    a0 = a1 = k  # nothing read; any legal source works
+                else:
+                    a0 = int(real.min()) // m_local
+                    a1 = int(real.max()) // m_local
+                    if a1 - a0 > 1:
+                        return None
+                perms[2 * w, k] = a0
+                perms[2 * w + 1, k] = a1
+                base = 2 * w * m_local
+                out[k] = np.where(
+                    ids == Z, 4 * m_local,
+                    base + (ids // m_local - a0) * m_local + ids % m_local,
+                )
+        levels.append((perms, hloc, tloc, sh))
+    return levels
+
+
+def _window_level(recvs, hloc, tloc, shift, P, dtype):
+    """One cross-shard merge level fed from the ppermute'd windows.
+
+    recvs : list of 4 (m_local, P) received buffers
+    hloc, tloc, shift : (m_local,) int32 receive-buffer-local tables
+    """
+    buf = jnp.concatenate(recvs + [jnp.zeros((1, P), dtype)])
+    return _merge_rows(buf, hloc, tloc, shift)
 
 
 def ffa2_seq(data, mesh=None, axis="seq"):
@@ -124,6 +208,10 @@ def ffa2_seq(data, mesh=None, axis="seq"):
 
         return ffa2(data)
 
+    wplan = _window_plan(m, S) if S >= 8 else None
+    if wplan is not None:
+        fn, tables = _seq_program_windowed(m, p, mesh, axis)
+        return np.asarray(fn(data, *tables))
     ch, ct, cs = _cross_tables(m, S)
     fn = _seq_program(m, p, mesh, axis)
     return np.asarray(fn(data, jnp.asarray(ch), jnp.asarray(ct), jnp.asarray(cs)))
@@ -140,7 +228,7 @@ def _seq_program(m, p, mesh, axis):
         # x: (m_local, p); h/t/shift: (L_cross, 1, m_local)
         y = ffa_transform_padded(x, m_local, p)
         for lvl in range(h.shape[0]):
-            y = _cross_level(y, h[lvl, 0], t[lvl, 0], shift[lvl, 0], p, axis)
+            y = _cross_level(y, h[lvl, 0], t[lvl, 0], shift[lvl, 0], axis)
         return y
 
     return jax.jit(
@@ -156,3 +244,75 @@ def _seq_program(m, p, mesh, axis):
             out_specs=Pspec(axis, None),
         )
     )
+
+
+def _split_perm(pairs):
+    """Split (src, dst) pairs into groups with unique sources (dsts are
+    globally unique already), greedily — jax.lax.ppermute accepts only
+    proper partial permutations."""
+    groups, srcs = [], []
+    for src, dst in pairs:
+        for g, ss in enumerate(srcs):
+            if src not in ss:
+                groups[g].append((src, dst))
+                ss.add(src)
+                break
+        else:
+            groups.append([(src, dst)])
+            srcs.append({src})
+    return groups
+
+
+@lru_cache(maxsize=64)
+def _seq_program_windowed(m, p, mesh, axis):
+    """Compiled windowed-ppermute transform (S >= 8). Returns
+    ``(jitted_fn, device_tables)``; the per-level permutations are baked
+    in as static collective permutes."""
+    S = mesh.shape[axis]
+    levels = _window_plan(m, S)
+    perms_by_level = [lv[0] for lv in levels]
+    # (L_cross, S, m_local) int32 operand tables, sharded over S.
+    hloc = np.stack([lv[1] for lv in levels])
+    tloc = np.stack([lv[2] for lv in levels])
+    shift = np.stack([lv[3] for lv in levels])
+
+    def shard_fn(x, hloc, tloc, shift):
+        y = ffa_transform_padded(x, m // S, p)
+        for lvl, perms in enumerate(perms_by_level):
+            recvs = []
+            seen = {}
+            for i in range(4):
+                key = tuple(perms[i])
+                if key in seen:
+                    recvs.append(recvs[seen[key]])
+                    continue
+                seen[key] = i
+                # ppermute requires unique sources; a window source
+                # feeding several destinations splits into disjoint
+                # partial permutes (unlisted destinations receive
+                # zeros), summed back together.
+                out = None
+                for group in _split_perm(
+                    [(int(src), dst) for dst, src in enumerate(perms[i])]
+                ):
+                    r = jax.lax.ppermute(y, axis, perm=group)
+                    out = r if out is None else out + r
+                recvs.append(out)
+            y = _window_level(recvs, hloc[lvl, 0], tloc[lvl, 0],
+                              shift[lvl, 0], p, y.dtype)
+        return y
+
+    fn = jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(
+                Pspec(axis, None),
+                Pspec(None, axis, None),
+                Pspec(None, axis, None),
+                Pspec(None, axis, None),
+            ),
+            out_specs=Pspec(axis, None),
+        )
+    )
+    return fn, (jnp.asarray(hloc), jnp.asarray(tloc), jnp.asarray(shift))

@@ -49,6 +49,38 @@ def test_seq_pulse_recovery():
     assert out[m - 1].max() == pytest.approx(m)
 
 
+@pytest.mark.parametrize("m_local", [8, 12, 13, 128])
+def test_seq_windowed_ppermute_matches(m_local):
+    """The S >= 8 production path (windowed ppermute exchange instead of
+    per-level all_gather) is bit-compatible with ffa2; covers
+    power-of-2 and non-power-of-2 local row counts."""
+    from riptide_tpu.parallel.seqffa import _window_plan
+
+    S = 8
+    m = S * m_local
+    assert _window_plan(m, S) is not None, "expected the windowed path"
+    rng = np.random.RandomState(m)
+    data = rng.normal(size=(m, 33)).astype(np.float32)
+    out = ffa2_seq(data, mesh=_mesh(S))
+    np.testing.assert_allclose(out, ffa2(data), rtol=1e-6, atol=1e-5)
+
+
+def test_seq_window_plan_bounds():
+    """Every window the plan emits spans at most two source shards, and
+    the receive-buffer-local ids stay inside the 4*m_local+1 buffer."""
+    from riptide_tpu.parallel.seqffa import _window_plan
+
+    for m, S in ((64, 8), (96, 8), (1024, 8), (104, 8)):
+        m_local = m // S
+        levels = _window_plan(m, S)
+        assert levels is not None
+        for perms, hloc, tloc, _ in levels:
+            assert perms.min() >= 0 and perms.max() < S
+            for loc in (hloc, tloc):
+                assert loc.min() >= 0
+                assert loc.max() <= 4 * m_local
+
+
 def test_seq_errors():
     data = np.zeros((10, 8), np.float32)
     with pytest.raises(ValueError, match="divisible"):
