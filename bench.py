@@ -131,6 +131,50 @@ def _parity_gate(plan, batch, tobs):
     )
 
 
+def _pipeline_pass(plan, tobs, nchunks, dms, batch_for, prepper, shipper):
+    """One pipelined pass over ``nchunks`` chunks — the production
+    queue-ahead posture shared by the headline and the survey configs:
+    the prep thread (CPU-bound native downsampling + quantisation)
+    works on chunk i+2 while the ship thread (wire-bound device_put)
+    moves chunk i+1 and the device computes chunk i; the main thread
+    only queues dispatches and syncs results. Steady state is
+    max(prep, wire, device) rather than their sum. Only chunk 0's
+    prep+ship (the pipeline fill) happens before the clock starts —
+    matching the reference baseline's data-in-memory timing posture;
+    every other chunk's prep AND wire transfer is inside the timed
+    window. ``batch_for(i)`` supplies chunk i's host batch. Returns
+    elapsed seconds."""
+    from riptide_tpu.search.engine import (
+        collect_search_batch, prepare_stage_data, queue_search_batch,
+        ship_stage_data,
+    )
+
+    def prep_ship(i):
+        fut = prepper.submit(prepare_stage_data, plan, batch_for(i))
+        return shipper.submit(
+            lambda f=fut: ship_stage_data(plan, f.result())
+        )
+
+    shipped = prep_ship(0).result()
+    t0 = time.perf_counter()
+    ship_futs = {1: prep_ship(1)} if nchunks > 1 else {}
+    pending = None
+    for i in range(nchunks):
+        handle = queue_search_batch(plan, None, tobs=tobs,
+                                    shipped=shipped, **PKW)  # async
+        if i + 2 < nchunks:
+            ship_futs[i + 2] = prep_ship(i + 2)
+        if i + 1 < nchunks:
+            shipped = ship_futs.pop(i + 1).result()
+        if pending is not None:
+            peaks, _ = collect_search_batch(pending, dms)  # syncs
+            assert peaks[0] and abs(peaks[0][0].period - 1.0) < 1e-4
+        pending = handle
+    peaks, _ = collect_search_batch(pending, dms)
+    assert peaks[0] and abs(peaks[0][0].period - 1.0) < 1e-4
+    return time.perf_counter() - t0
+
+
 def bench_headline():
     """Pipelined survey throughput: CHUNKS batches of D trials, with the
     host half (native threaded downsampling + wire packing) of batch i+1
@@ -140,7 +184,6 @@ def bench_headline():
 
     from riptide_tpu.ffautils import generate_width_trials
     from riptide_tpu.search import periodogram_plan
-    from riptide_tpu.search.engine import prepare_stage_data
 
     widths = tuple(int(w) for w in generate_width_trials(BINS_MIN))
     plan = periodogram_plan(
@@ -168,47 +211,11 @@ def bench_headline():
         file=sys.stderr,
     )
 
-    from riptide_tpu.search.engine import (
-        collect_search_batch, queue_search_batch, ship_stage_data,
-    )
-
     dms = np.zeros(D)
 
     def timed_pipeline(prepper, shipper):
-        # Three-stage host pipeline over dedicated threads: the prep
-        # thread (CPU-bound native downsampling + quantisation) works on
-        # chunk i+2 while the ship thread (wire-bound device_put) moves
-        # chunk i+1 and the device computes chunk i; the main thread
-        # only queues dispatches and syncs results. Steady state is
-        # max(prep, wire, device) rather than their sum. Only chunk 0's
-        # prep+ship (the pipeline fill) happens before the clock starts
-        # — steady-state survey throughput, matching the reference
-        # baseline's data-in-memory timing posture; every other chunk's
-        # prep AND wire transfer is inside the timed window.
-        def prep_ship(i):
-            fut = prepper.submit(prepare_stage_data, plan, batches[i % 2])
-            return shipper.submit(
-                lambda f=fut: ship_stage_data(plan, f.result())
-            )
-        ship_futs = {0: prep_ship(0)}
-        shipped = ship_futs.pop(0).result()
-        t0 = time.perf_counter()
-        ship_futs[1] = prep_ship(1)
-        pending = None
-        for i in range(CHUNKS):
-            handle = queue_search_batch(plan, None, tobs=tobs,
-                                        shipped=shipped, **PKW)  # async
-            if i + 2 < CHUNKS:
-                ship_futs[i + 2] = prep_ship(i + 2)
-            if i + 1 < CHUNKS:
-                shipped = ship_futs.pop(i + 1).result()
-            if pending is not None:
-                peaks, _ = collect_search_batch(pending, dms)  # syncs
-                assert peaks[0] and abs(peaks[0][0].period - 1.0) < 1e-4
-            pending = handle
-        peaks, _ = collect_search_batch(pending, dms)
-        assert peaks[0] and abs(peaks[0][0].period - 1.0) < 1e-4
-        return time.perf_counter() - t0
+        return _pipeline_pass(plan, tobs, CHUNKS, dms,
+                              lambda i: batches[i % 2], prepper, shipper)
 
     def emit(elapsed, npasses):
         trials_per_sec = D * CHUNKS / elapsed
@@ -365,23 +372,28 @@ def bench_config5(d=1024):
 
 
 def _survey(d, n, metric, chunk=32):
+    """Chunked survey throughput through the shared
+    :func:`_pipeline_pass` queue-ahead posture (the same as the
+    headline and the pipeline's BatchSearcher)."""
+    from concurrent.futures import ThreadPoolExecutor
+
     from riptide_tpu.ffautils import generate_width_trials
     from riptide_tpu.search import periodogram_plan
-    from riptide_tpu.search.engine import run_search_batch
+    from riptide_tpu.search.engine import run_search_batch, warm_stage_kernels
 
+    assert d % chunk == 0, "survey configs use whole chunks"
     widths = tuple(int(w) for w in generate_width_trials(BINS_MIN))
     plan = periodogram_plan(n, TSAMP, widths, PERIOD_MIN, PERIOD_MAX,
                             BINS_MIN, BINS_MAX)
     tobs = n * TSAMP
-    batch = _make_batch(min(chunk, d), n, TSAMP)
+    warm_stage_kernels(plan, chunk)
+    batch = _make_batch(chunk, n, TSAMP)
+    dms = np.zeros(chunk)
     run_search_batch(plan, batch, tobs=tobs, **PKW)  # warm
-    t0 = time.perf_counter()
-    done = 0
-    while done < d:
-        take = min(chunk, d - done)
-        peaks, _ = run_search_batch(plan, batch[:take], tobs=tobs, **PKW)
-        done += take
-    dt = time.perf_counter() - t0
+    with ThreadPoolExecutor(max_workers=1) as prepper, \
+            ThreadPoolExecutor(max_workers=1) as shipper:
+        dt = _pipeline_pass(plan, tobs, d // chunk, dms, lambda i: batch,
+                            prepper, shipper)
     _emit(metric, d / dt, "DM-trials/s", extra={"total_seconds": round(dt, 2)})
 
 
