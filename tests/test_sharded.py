@@ -126,6 +126,27 @@ def test_search_sharded_u6_wire_parity(setup):
     assert got[2] and abs(got[2][0].period - 0.1) < 1e-3
 
 
+def test_search_sharded_f16_wire_parity(setup):
+    """The float16 wire through the sharded path: same transport on
+    both sides must produce identical peaks (covers the float branch of
+    the in-shard_map decode, which u6/u8/u12 tests do not touch)."""
+    plan, batch, _ = setup
+    tobs = N * TSAMP
+    dms = [0.0, 5.0, 10.0, 15.0, 20.0]
+    from riptide_tpu.search.engine import prepare_stage_data
+
+    prepared = prepare_stage_data(plan, batch, mode="float16")
+    want, _ = run_search_batch(plan, None, tobs=tobs, dms=dms,
+                               prepared=prepared, **PKW)
+    got, _ = run_search_sharded(plan, batch, tobs=tobs, dms=dms,
+                                mesh=default_mesh(), mode="float16", **PKW)
+    for d in range(len(batch)):
+        wset = [(p.ip, p.iw, round(p.snr, 4), p.dm) for p in want[d]]
+        gset = [(p.ip, p.iw, round(p.snr, 4), p.dm) for p in got[d]]
+        assert gset == wset, f"trial {d}"
+    assert got[2] and abs(got[2][0].period - 0.1) < 1e-3
+
+
 def test_pipeline_with_mesh(tmp_path):
     """Pipeline(mesh=...) end-to-end on synthetic PRESTO data: the
     DM-10 fake pulsar must come out as the top candidate through the
