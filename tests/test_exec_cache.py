@@ -49,6 +49,30 @@ def test_key_distinguishes_numpy_scalar_values():
     assert f._key([Tok()]) != f._key([Tok2()])
 
 
+def test_key_distinguishes_shardings():
+    """A dm-sharded and an unsharded array of identical shape must not
+    share an AOT executable (the compiled program bakes in the input
+    sharding)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    @cached_jit
+    def f(x):
+        return x + 1
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs a multi-device (virtual CPU) backend")
+    mesh = Mesh(np.array(devs), ("dm",))
+    plain = jnp.zeros((len(devs), 4))
+    sharded = jax.device_put(
+        np.zeros((len(devs), 4), np.float32),
+        NamedSharding(mesh, PartitionSpec("dm", None)),
+    )
+    assert f._key([plain]) != f._key([sharded])
+    assert f._key([sharded]) == f._key([sharded])
+
+
 def test_aot_path_on_forced_backend(monkeypatch, tmp_path):
     """With the backend check forced on, the wrapper AOT-compiles,
     memoizes per signature, and still returns correct results for both
