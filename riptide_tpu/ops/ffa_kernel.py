@@ -556,7 +556,14 @@ class CycleKernel:
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
-        out = self.build(x.shape[0])(scal, coef, x, wrep)
+        call = self.build(x.shape[0])
+        if isinstance(x, jax.core.Tracer) and hasattr(call, "jitted"):
+            # Inside an outer trace (the sharded path calls the kernel
+            # from a shard_map body): an AOT-compiled executable cannot
+            # take tracers — inline the plain jitted pallas call, which
+            # the outer program compiles as part of itself.
+            call = call.jitted
+        out = call(scal, coef, x, wrep)
         return out[0] if squeeze else out
 
 

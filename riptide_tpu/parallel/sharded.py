@@ -48,6 +48,7 @@ from ..search.engine import (
     _wire_mode,
     prepare_stage_data,
 )
+from ..utils.exec_cache import _Cached
 
 __all__ = ["run_periodogram_sharded", "run_search_sharded",
            "queue_search_sharded", "collect_search_sharded",
@@ -124,6 +125,16 @@ def _stage_sharded_call(mesh, st, plan, meta, i, with_bins):
     sc_spec = Pspec(None, "dm") if mode == "uint12" else dm2
     has_scales = mode in ("uint6", "uint8", "uint12")
     n = st.n
+    # Cross-process AOT cache for the compiled shard_map program: the
+    # Pallas kernel inlines into it (an AOT executable cannot take the
+    # shard_map trace's tracers), so without this every fresh process
+    # would re-pay the kernel's multi-minute Mosaic compile on the
+    # sharded path. Keyed per stage + mesh layout + wire mode (the
+    # _Cached wrapper adds package source hash, device kind and the
+    # arrays' shapes/dtypes/SHARDINGS).
+    cache_name = repr(("sharded_stage", getattr(plan, "cache_token", None),
+                       plan.stages.index(st), mode, with_bins,
+                       tuple(mesh.shape.items()), mesh.axis_names))
     use_kernel = (
         path == "kernel" and not with_bins and _kernel_eligible(st, plan)
     )
@@ -141,9 +152,15 @@ def _stage_sharded_call(mesh, st, plan, meta, i, with_bins):
             return kern(x)[..., :remax, :nw]
 
         in_specs = (dm2, sc_spec) if has_scales else (dm2,)
-        smapped = jax.jit(jax.shard_map(
-            local, mesh=mesh, in_specs=in_specs, out_specs=dm
-        ))
+        # check_vma=False: pallas_call output avals carry no
+        # varying-mesh-axes annotation, which the default shard_map
+        # checking rejects on real (non-interpret) backends; the kernel
+        # program contains no collectives, so the check has nothing to
+        # verify here.
+        smapped = _Cached(jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=in_specs, out_specs=dm,
+            check_vma=False,
+        )), cache_name)
 
         def wrapped(flat_dev, meta_dev, smapped=smapped):
             args = ((meta_dev["scales_dev"],) if has_scales else ())
@@ -166,10 +183,10 @@ def _stage_sharded_call(mesh, st, plan, meta, i, with_bins):
             Pspec(b), Pspec(b),
             Pspec(b, None), Pspec(b, None), Pspec(b),
         )
-        smapped = jax.jit(jax.shard_map(
+        smapped = _Cached(jax.jit(jax.shard_map(
             local, mesh=mesh, in_specs=in_specs,
             out_specs=Pspec("dm", b, None, None),
-        ))
+        )), cache_name)
 
         def wrapped(flat_dev, meta_dev, smapped=smapped, st=st):
             ops = _stage_operands(st)

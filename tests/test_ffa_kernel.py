@@ -224,3 +224,29 @@ def test_engine_kernel_path_parity(monkeypatch):
     monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "float16")
     _, _, s16 = run_periodogram(plan, data)
     np.testing.assert_allclose(s16, sg, atol=2e-2)
+
+
+def test_cycle_kernel_traceable_under_outer_trace():
+    """Inside an outer trace (the sharded path calls the kernel from a
+    shard_map body) the kernel must inline its plain jitted pallas call
+    — an AOT-compiled _CachedCall executable cannot take tracers. Built
+    NON-interpret so build() returns the _CachedCall wrapper, then
+    traced (not compiled: Mosaic cannot lower on CPU, but tracing stops
+    before lowering)."""
+    import jax
+
+    from riptide_tpu.ops.snr import boxcar_coeffs as _bc
+
+    ms, ps, widths = [12, 13], [16, 17], (1, 2, 3)
+    B = len(ms)
+    h = np.zeros((B, 3), np.float32)
+    b = np.zeros((B, 3), np.float32)
+    for i, p in enumerate(ps):
+        h[i], b[i] = _bc(p, widths)
+    k = CycleKernel(ms, ps, widths, h, b, np.ones(B, np.float32),
+                    interpret=False)
+    call = k.build(2)
+    assert hasattr(call, "jitted"), "expected the _CachedCall wrapper"
+    x = np.zeros((2, B, k.rows, k.P), np.float32)
+    jaxpr = jax.make_jaxpr(lambda xx: k(xx))(x)
+    assert "pallas_call" in str(jaxpr), "kernel did not inline into the trace"
