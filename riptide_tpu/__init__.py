@@ -32,7 +32,7 @@ from .serialization import save_json, load_json
 from .peak_detection import find_peaks, Peak
 from .candidate import Candidate
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 
 def test():

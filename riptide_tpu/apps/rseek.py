@@ -2,10 +2,14 @@
 rseek: FFA-search a single dedispersed time series and print a table of
 significant peaks. Same CLI surface and defaults as the reference's
 ``rseek`` console script (riptide/apps/rseek.py); the search itself runs
-on the default JAX device (TPU when available).
+on the default JAX device (TPU when available). Supports the survey
+subsystem's journaling (``--journal``/``--resume``) and fault-injection
+(``--fault-inject``) machinery, treating the whole search as a single
+work unit.
 """
 import argparse
 import logging
+import time
 
 import numpy as np
 
@@ -22,70 +26,71 @@ def get_parser():
     parser = argparse.ArgumentParser(
         formatter_class=_help_formatter,
         description=(
-            "FFA search a single time series and print a table of parameters "
-            "of all significant peaks found. Peaks found with nearly identical "
-            "periods at different trial pulse widths are grouped, but no "
-            "harmonic filtering is performed."
+            "Run an FFA periodogram search on one dedispersed time series "
+            "and print every significant peak's parameters. Nearby peaks "
+            "from different width trials are merged into one line per "
+            "period; harmonics are left in the output."
         ),
     )
     parser.add_argument(
         "-f", "--format", type=str, choices=("presto", "sigproc"), required=True,
-        help="Input TimeSeries format",
+        help="File format of the input time series",
     )
-    parser.add_argument("--Pmin", type=float, default=1.0, help="Minimum trial period in seconds")
-    parser.add_argument("--Pmax", type=float, default=10.0, help="Maximum trial period in seconds")
-    parser.add_argument("--bmin", type=int, default=240, help="Minimum number of phase bins used in the search")
-    parser.add_argument("--bmax", type=int, default=260, help="Maximum number of phase bins used in the search")
-    parser.add_argument("--smin", type=float, default=7.0, help="Only report peaks above this minimum S/N")
+    parser.add_argument("--Pmin", type=float, default=1.0,
+                        help="Shortest trial period, in seconds")
+    parser.add_argument("--Pmax", type=float, default=10.0,
+                        help="Longest trial period, in seconds")
+    parser.add_argument("--bmin", type=int, default=240,
+                        help="Lower bound on the phase-bin count of a trial folding")
+    parser.add_argument("--bmax", type=int, default=260,
+                        help="Upper bound on the phase-bin count of a trial folding")
+    parser.add_argument("--smin", type=float, default=7.0,
+                        help="Drop peaks whose S/N falls below this value")
     parser.add_argument(
         "--wtsp", type=float, default=1.5,
-        help="Geometric factor between consecutive trial pulse widths",
+        help="Ratio between one trial pulse width and the next in the ladder",
     )
     parser.add_argument(
         "--rmed_width", type=float, default=4.0,
-        help="Width (in seconds) of the running median filter to subtract "
-        "from the input data before processing",
+        help="Running-median detrending window length, in seconds",
     )
     parser.add_argument(
         "--rmed_minpts", type=float, default=101,
-        help="Minimum number of scrunched samples that must fit in the "
-        "running median window (lower is faster but less accurate)",
+        help="Smallest number of downsampled points the running-median "
+        "window may span (smaller runs faster at some accuracy cost)",
     )
     parser.add_argument(
         "--clrad", type=float, default=0.2,
-        help="Frequency clustering radius in units of 1/Tobs. Peaks with "
-        "similar freqs are grouped together, and only the brightest one of "
-        "the group is printed",
+        help="Radius (in units of 1/Tobs) for merging peaks of nearly equal "
+        "frequency; only the brightest peak of each group is printed",
+    )
+    parser.add_argument(
+        "--journal", type=str, default=None,
+        help="Journal directory: record the completed search (peaks + "
+        "metrics) so a later --resume run can replay it",
+    )
+    parser.add_argument(
+        "--resume", action="store_true",
+        help="Replay the peaks recorded in --journal instead of searching, "
+        "when the journal already holds this input's completed search",
+    )
+    parser.add_argument(
+        "--fault-inject", type=str, default=None,
+        help="Fault-injection spec for robustness testing: raise/stall/"
+        "abort directives on chunk 0, e.g. 'raise:0' (see "
+        "riptide_tpu.survey.faults); the search retries with backoff",
     )
     parser.add_argument("fname", type=str, help="Input file name")
     parser.add_argument("--version", action="version", version=__version__)
     return parser
 
 
-def run_program(args):
-    """
-    Run rseek; returns a pandas DataFrame of detected peak parameters
-    (columns period/freq/width/ducy/dm/snr), or None if nothing
-    significant was found.
-    """
-    import pandas
-
-    from riptide_tpu import TimeSeries, ffa_search
-    from riptide_tpu.clustering import cluster1d
+def _search_peaks(args, ts):
+    """The rseek work unit: ffa_search + find_peaks on the loaded
+    series. Returns the raw Peak list (possibly empty)."""
+    from riptide_tpu import ffa_search
     from riptide_tpu.peak_detection import find_peaks
 
-    logging.basicConfig(
-        level="DEBUG",
-        format="%(asctime)s %(filename)18s:%(lineno)-4s %(levelname)-8s %(message)s",
-    )
-
-    loaders = {"sigproc": TimeSeries.from_sigproc, "presto": TimeSeries.from_presto_inf}
-    ts = loaders[args.format](args.fname)
-
-    log.debug(
-        f"Searching period range [{args.Pmin}, {args.Pmax}] seconds "
-        f"with {args.bmin} to {args.bmax} phase bins"
-    )
     _, pgram = ffa_search(
         ts,
         period_min=args.Pmin,
@@ -99,6 +104,85 @@ def run_program(args):
         ducy_max=0.3,
     )
     peaks, _ = find_peaks(pgram, smin=args.smin, clrad=args.clrad)
+    return peaks
+
+
+def _search_with_survey_hooks(args, ts):
+    """Run the search under the survey machinery: optional journal
+    replay (--resume), retry/backoff with fault injection, and a journal
+    record of the completed unit."""
+    import os
+
+    from riptide_tpu.survey.faults import FaultPlan
+    from riptide_tpu.survey.journal import SurveyJournal
+    from riptide_tpu.survey.metrics import get_metrics
+    from riptide_tpu.survey.scheduler import (
+        RetryPolicy, run_with_retry, survey_identity,
+    )
+
+    if args.resume and not args.journal:
+        raise ValueError("--resume requires --journal")
+    journal = SurveyJournal(args.journal) if args.journal else None
+    sid = survey_identity(
+        [args.fname],
+        {k: getattr(args, k) for k in
+         ("Pmin", "Pmax", "bmin", "bmax", "smin", "wtsp",
+          "rmed_width", "rmed_minpts", "clrad")},
+    )
+    if journal is not None:
+        journal.write_header(sid, 1)
+        if args.resume:
+            done = journal.completed_chunks()
+            if 0 in done and done[0][0].get("files") == \
+                    [os.path.basename(args.fname)]:
+                log.info("resuming: peaks replayed from journal "
+                         f"{args.journal!r}")
+                get_metrics().add("chunks_skipped")
+                return done[0][1]
+
+    faults = FaultPlan.parse(args.fault_inject
+                             or os.environ.get("RIPTIDE_FAULT_INJECT"))
+    metrics = get_metrics()
+    t0 = time.perf_counter()
+    peaks, attempts = run_with_retry(
+        lambda: _search_peaks(args, ts), 0, RetryPolicy(), faults, metrics,
+    )
+    metrics.add("chunks_done")
+    metrics.observe("chunk_s", time.perf_counter() - t0)
+    if journal is not None:
+        journal.record_chunk(
+            0, [args.fname], [float(ts.metadata["dm"] or 0.0)], peaks,
+            timings={"chunk_s": round(time.perf_counter() - t0, 6)},
+            attempts=attempts,
+        )
+        journal.record_metrics(metrics.summary())
+    return peaks
+
+
+def run_program(args):
+    """
+    Run rseek; returns a pandas DataFrame of detected peak parameters
+    (columns period/freq/width/ducy/dm/snr), or None if nothing
+    significant was found.
+    """
+    import pandas
+
+    from riptide_tpu import TimeSeries
+    from riptide_tpu.clustering import cluster1d
+
+    logging.basicConfig(
+        level="DEBUG",
+        format="%(asctime)s %(filename)18s:%(lineno)-4s %(levelname)-8s %(message)s",
+    )
+
+    loaders = {"sigproc": TimeSeries.from_sigproc, "presto": TimeSeries.from_presto_inf}
+    ts = loaders[args.format](args.fname)
+
+    log.debug(
+        f"Searching period range [{args.Pmin}, {args.Pmax}] seconds "
+        f"with {args.bmin} to {args.bmax} phase bins"
+    )
+    peaks = _search_with_survey_hooks(args, ts)
     if not peaks:
         print(f"No peaks found above S/N = {args.smin:.2f}")
         return None

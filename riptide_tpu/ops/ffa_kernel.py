@@ -317,6 +317,47 @@ def _pack_coef(ps, widths, hcoef, bcoef, stdnoise):
 # keying invalidated every entry, VERDICT r4 item 1).
 KERNEL_CACHE_VERSION = 5
 
+
+def _hash_code_object(h, code):
+    """Feed one code object (and its nested code objects) into a hash:
+    raw bytecode plus the global/attribute names it references. Local
+    variable names and docstrings are excluded — renames and comment or
+    docstring edits are exactly the changes that must NOT demand a
+    KERNEL_CACHE_VERSION bump."""
+    import types as _types
+
+    h.update(code.co_code)
+    h.update("\0".join(code.co_names).encode())
+    consts = code.co_consts
+    if consts and isinstance(consts[0], str):
+        consts = consts[1:]  # docstring slot
+    for c in consts:
+        if isinstance(c, _types.CodeType):
+            _hash_code_object(h, c)
+        else:
+            h.update(repr(c).encode())
+
+
+def kernel_code_digest():
+    """Bytecode digest of everything :data:`KERNEL_CACHE_VERSION`
+    vouches for: this file's kernel body and packing helpers, and
+    slottables' table builders / packed-word layout. The guard test
+    pins (version, digest) pairs so a semantic edit to any of these
+    without a version bump fails CI — a stale cached executable with a
+    mismatched table layout computes wrong numbers, not a crash. The
+    digest is bytecode-based and therefore specific to the running
+    Python's major.minor version."""
+    from . import slottables
+
+    h = hashlib.sha1()
+    for fn in (_kernel, _pack_scal, _pack_coef, slottables.pack_word,
+               slottables.build_tables, slottables._merge_tables,
+               slottables.container_rows):
+        h.update(fn.__name__.encode())
+        _hash_code_object(h, fn.__code__)
+    return h.hexdigest()
+
+
 _EXEC_DIR = None
 
 

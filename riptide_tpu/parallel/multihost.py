@@ -20,13 +20,15 @@ import numpy as np
 
 import jax
 
-from ..peak_detection import Peak
+from ..peak_detection import PEAK_FIELDS, PEAK_INT_FIELDS, Peak
+from ..survey.metrics import get_metrics
 
 __all__ = ["gather_peaks", "run_search_multihost"]
 
-# Peak is a flat record of 8 numeric fields; encode/decode as float64.
-_FIELDS = ("period", "freq", "width", "ducy", "iw", "ip", "snr", "dm")
-_INT_FIELDS = {"width", "iw", "ip"}
+# Peak is a flat record of 8 numeric fields; encode/decode as float64
+# in the canonical PEAK_FIELDS order (shared with the survey journal).
+_FIELDS = PEAK_FIELDS
+_INT_FIELDS = PEAK_INT_FIELDS
 
 
 def _encode(peaks):
@@ -56,28 +58,35 @@ def gather_peaks(local_peaks):
         return local_peaks
     from jax.experimental import multihost_utils
 
-    arr = _encode(local_peaks)
-    counts = multihost_utils.process_allgather(
-        np.asarray([arr.shape[0]], np.int64)
-    ).reshape(-1)
-    mx = max(int(counts.max()), 1)
-    padded = np.zeros((mx, len(_FIELDS)), np.float64)
-    padded[: arr.shape[0]] = arr
-    gathered = multihost_utils.process_allgather(padded)
-    out = []
-    for cnt, block in zip(counts, gathered):
-        out.extend(_decode(block[: int(cnt)]))
+    with get_metrics().timer("gather_s"):
+        arr = _encode(local_peaks)
+        counts = multihost_utils.process_allgather(
+            np.asarray([arr.shape[0]], np.int64)
+        ).reshape(-1)
+        mx = max(int(counts.max()), 1)
+        padded = np.zeros((mx, len(_FIELDS)), np.float64)
+        padded[: arr.shape[0]] = arr
+        gathered = multihost_utils.process_allgather(padded)
+        out = []
+        for cnt, block in zip(counts, gathered):
+            out.extend(_decode(block[: int(cnt)]))
     return out
 
 
 def run_search_multihost(plan, batch_local, tobs, dms_local=None,
-                         **peak_kwargs):
+                         journal=None, chunk_id=0, **peak_kwargs):
     """
     Search this process's local DM-trial batch and exchange results:
     returns (peaks, polycos_local) where ``peaks`` is the SAME global
     flat Peak list on every process (sorted by decreasing S/N) and
     ``polycos_local`` are this process's per-trial threshold
     polynomials.
+
+    When a :class:`~riptide_tpu.survey.SurveyJournal` is given, process
+    0 — and ONLY process 0, so a shared journal directory sees exactly
+    one writer — records the gathered result as chunk ``chunk_id``
+    together with a metrics snapshot. Every process returns the same
+    peaks, so the single-writer record is complete.
     """
     from ..search.engine import run_search_batch
 
@@ -89,4 +98,12 @@ def run_search_multihost(plan, batch_local, tobs, dms_local=None,
     )
     flat = [p for trial in peaks_per_trial for p in trial]
     peaks = sorted(gather_peaks(flat), key=lambda p: p.snr, reverse=True)
+    if journal is not None and jax.process_index() == 0:
+        metrics = get_metrics()
+        journal.record_chunk(
+            chunk_id, files=[], dms=[float(d) for d in np.ravel(dms_local)],
+            peaks=peaks,
+        )
+        journal.record_metrics(metrics.summary())
+        metrics.add("chunks_done")
     return peaks, polycos

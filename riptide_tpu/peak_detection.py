@@ -46,6 +46,14 @@ class Peak(typing.NamedTuple):
         return {a: getattr(self, a) for a in attrs}
 
 
+# Canonical flat-record layout for Peak serialization (survey journal,
+# multihost gather): field order IS the NamedTuple order, and these are
+# the integer-valued fields. One definition so the encoders cannot
+# drift apart and misdecode values into the wrong fields.
+PEAK_FIELDS = Peak._fields
+PEAK_INT_FIELDS = frozenset(("width", "iw", "ip"))
+
+
 def segment_stats(f, s, T, segwidth=5.0):
     """
     Cut a periodogram into equal segments spanning ``segwidth / T`` in

@@ -30,6 +30,7 @@ import numpy as np
 from ..ffautils import generate_width_trials
 from ..search import periodogram_plan
 from ..search.engine import collect_search_batch, queue_search_batch
+from ..survey.metrics import get_metrics
 from ..time_series import TimeSeries
 
 log = logging.getLogger("riptide_tpu.pipeline.batcher")
@@ -93,6 +94,7 @@ class BatchSearcher:
         Returns a flat list of Peaks."""
         chunks = [list(c) for c in fname_chunks]
         peaks = []
+        metrics = get_metrics()
         # Three pools: `stager` runs the one-per-chunk CPU-bound prepare
         # task (load + detrend + wire preparation), `shipper` runs the
         # wire-bound device transfer of the prepared chunk, and
@@ -113,6 +115,7 @@ class BatchSearcher:
             pending = stager.submit(stage_chunk, chunks[0]) if chunks else None
             queued = None
             for i, chunk in enumerate(chunks):
+                metrics.set_gauge("queue_depth", len(chunks) - i)
                 ship_fut = pending.result()   # prep done, ship submitted
                 if i + 1 < len(chunks):
                     pending = stager.submit(stage_chunk, chunks[i + 1])
@@ -123,6 +126,7 @@ class BatchSearcher:
                 nxt = self._queue_chunk(items)
                 if queued is not None:
                     peaks.extend(self._collect_chunk(queued))
+                    metrics.add("chunks_done")
                 queued = nxt
                 log.debug(
                     f"Chunk {i + 1}/{len(chunks)} ({len(chunk)} files) "
@@ -130,6 +134,8 @@ class BatchSearcher:
                 )
             if queued is not None:
                 peaks.extend(self._collect_chunk(queued))
+                metrics.add("chunks_done")
+            metrics.set_gauge("queue_depth", 0)
         return peaks
 
     def process_fname_list(self, fnames):

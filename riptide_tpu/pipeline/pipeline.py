@@ -106,12 +106,31 @@ class Pipeline:
     mesh : jax.sharding.Mesh or None
         Optional device mesh; when given, the DM batch of each search
         chunk is sharded over its 'dm' axis.
+    journal : str or None
+        Directory for the survey journal. When set, the search stage
+        runs through the checkpointed
+        :class:`riptide_tpu.survey.SurveyScheduler`: completed chunks
+        are journaled (fsync'd) as they finish, device dispatch retries
+        with exponential backoff, and ``resume=True`` replays journaled
+        chunks instead of re-searching them.
+    resume : bool
+        Resume from the journal (requires ``journal``).
+    fault_spec : str or None
+        Fault-injection spec (see :mod:`riptide_tpu.survey.faults`);
+        defaults to the ``RIPTIDE_FAULT_INJECT`` environment variable.
     """
 
-    def __init__(self, config, mesh=None, trace_dir=None):
+    def __init__(self, config, mesh=None, trace_dir=None, journal=None,
+                 resume=False, fault_spec=None):
         self.config = validate_pipeline_config(config)
         self.mesh = mesh
         self.trace_dir = trace_dir
+        self.journal_dir = journal
+        self.resume = bool(resume)
+        self.fault_spec = (fault_spec if fault_spec is not None
+                           else os.environ.get("RIPTIDE_FAULT_INJECT"))
+        if self.resume and not self.journal_dir:
+            raise ValueError("resume=True requires a journal directory")
         self.dmiter = None
         self.searcher = None
         self.peaks = []
@@ -196,15 +215,42 @@ class Pipeline:
     def search(self):
         """Search all selected DM trials in device-sized batches. The
         config's 'processes' value sets the DM batch size per program (it
-        is a host I/O thread count here, not a worker process count)."""
+        is a host I/O thread count here, not a worker process count).
+        With a journal configured the chunk queue runs through the
+        checkpointed survey scheduler (resume / retry / fault
+        injection); otherwise through the batcher's maximally
+        overlapped stream."""
         log.info("Running search")
         batch = max(self.config["processes"], 1)
+        chunks = [list(c) for c in
+                  self.dmiter.iterate_filenames(chunksize=batch)]
         with maybe_trace(self.trace_dir):
-            peaks = self.searcher.process_stream(
-                self.dmiter.iterate_filenames(chunksize=batch)
-            )
+            if self.journal_dir:
+                peaks = self._search_journaled(chunks)
+            else:
+                peaks = self.searcher.process_stream(chunks)
         self.peaks = sorted(peaks, key=lambda p: p.period)
         log.info(f"Total peaks found: {len(peaks)}")
+
+    def _search_journaled(self, chunks):
+        """Checkpointed search through the survey scheduler."""
+        from ..survey.faults import FaultPlan
+        from ..survey.journal import SurveyJournal
+        from ..survey.scheduler import SurveyScheduler, survey_identity
+
+        survey_id = survey_identity(
+            [f for c in chunks for f in c],
+            {"ranges": self.config["ranges"],
+             "dereddening": self.config["dereddening"]},
+        )
+        scheduler = SurveyScheduler(
+            self.searcher, chunks,
+            journal=SurveyJournal(self.journal_dir),
+            resume=self.resume,
+            faults=FaultPlan.parse(self.fault_spec),
+            survey_id=survey_id,
+        )
+        return scheduler.run()
 
     @timing
     def cluster_peaks(self):
@@ -392,12 +438,12 @@ class Pipeline:
         self.save_products(outdir=outdir)
 
     @classmethod
-    def from_yaml_config(cls, fname, mesh=None):
+    def from_yaml_config(cls, fname, mesh=None, **kwargs):
         log.debug(f"Creating pipeline from config file: {fname}")
         with open(fname) as fobj:
             conf = yaml.safe_load(fobj)
         log.debug(f"Pipeline configuration: {json.dumps(conf, indent=4)}")
-        return cls(conf, mesh=mesh)
+        return cls(conf, mesh=mesh, **kwargs)
 
 
 # ----------------------------------------------------------------------------
@@ -433,6 +479,17 @@ def get_parser():
                         help="Capture a jax.profiler device trace of the "
                              "search stage into this directory (view with "
                              "TensorBoard's profile plugin or Perfetto)")
+    parser.add_argument("--journal", type=str, default=None,
+                        help="Survey journal directory: checkpoint each "
+                             "completed DM chunk (with retry/backoff around "
+                             "device dispatch) so a killed run can resume")
+    parser.add_argument("--resume", action="store_true",
+                        help="Resume from the --journal directory, skipping "
+                             "chunks it already records")
+    parser.add_argument("--fault-inject", type=str, default=None,
+                        help="Fault-injection spec for robustness testing, "
+                             "e.g. 'raise:2,stall:1:0.5' (see "
+                             "riptide_tpu.survey.faults)")
     parser.add_argument("--version", action="version", version=__version__)
     parser.add_argument("files", type=str, nargs="+",
                         help="Input file(s) of the configured format")
@@ -459,7 +516,12 @@ def run_program(args):
         "DEBUG" if args.log_timings else "WARNING"
     )
 
-    pipeline = Pipeline.from_yaml_config(args.config)
+    pipeline = Pipeline.from_yaml_config(
+        args.config,
+        journal=getattr(args, "journal", None),
+        resume=getattr(args, "resume", False),
+        fault_spec=getattr(args, "fault_inject", None),
+    )
     pipeline.trace_dir = getattr(args, "trace_dir", None)
     pipeline.process(args.files, args.outdir)
     log.info("CALCULATIONS CORRECT")

@@ -25,6 +25,7 @@ parallelism (riptide/pipeline/worker_pool.py) with one SPMD program.
 """
 import logging
 import os
+import time
 from functools import partial
 
 import jax
@@ -34,6 +35,7 @@ import numpy as np
 log = logging.getLogger("riptide_tpu.search.engine")
 
 from ..ops.downsample import downsample_gather, split_prefix_sums
+from ..survey.metrics import get_metrics
 from ..utils.exec_cache import cached_jit
 from ..ops.ffa import ffa_levels
 from ..ops.ffa_kernel import NWPAD
@@ -717,6 +719,7 @@ def prepare_stage_data(plan, batch, mode=None):
     batch = np.asarray(batch, dtype=np.float32)
     if batch.ndim != 2 or batch.shape[1] != plan.size:
         raise ValueError("batch must be (D, N) with N matching the plan")
+    t0 = time.perf_counter()
     path = _ffa_path()
     mode = mode or _wire_mode(path)
     offs, lens, tot = _wire_layout(plan, mode)
@@ -736,6 +739,7 @@ def prepare_stage_data(plan, batch, mode=None):
             flat[:, offs[i] : offs[i] + st.n] = xds[i][..., : st.n]
     meta = {"path": path, "mode": mode, "offs": offs, "lens": lens,
             "scales": scales}
+    get_metrics().observe("prep_s", time.perf_counter() - t0)
     return flat, meta
 
 
@@ -747,6 +751,7 @@ def ship_stage_data(plan, prepared):
     pass to :func:`run_search_batch` as ``shipped`` to start the next
     batch's transfer while the current one computes."""
     flat, meta = prepared
+    t0 = time.perf_counter()
     S = len(plan.stages)
     starts = np.concatenate(
         [meta["offs"], [meta["offs"][-1] + meta["lens"][-1]]]
@@ -765,6 +770,9 @@ def ship_stage_data(plan, prepared):
     if meta["mode"] in ("uint8", "uint6"):
         soffs, nblks, _ = _scale_layout(plan)
         meta["soffs"], meta["nblks"] = soffs, nblks
+    reg = get_metrics()
+    reg.observe("wire_s", time.perf_counter() - t0)
+    reg.add("wire_bytes", int(flat.nbytes))
     return parts, part_of, meta
 
 
@@ -833,7 +841,8 @@ def collect_search_batch(handle, dms):
     from .peaks_device import collect_peaks
 
     pp, peaks_handle = handle
-    return collect_peaks(pp, peaks_handle, dms)
+    with get_metrics().timer("device_s"):
+        return collect_peaks(pp, peaks_handle, dms)
 
 
 def search_snr_dev(handle):

@@ -1,0 +1,46 @@
+"""
+Checkpointed survey execution: journal, scheduler, fault injection and
+the metrics registry.
+
+A full survey (e.g. 1024 DM trials x 2^23 samples) runs for long enough
+that preemption, transient device errors or tunnel stalls are expected
+events, not exceptional ones. This package makes survey runs resumable
+and observable:
+
+* :mod:`riptide_tpu.survey.journal` — append-only JSONL record of
+  completed work units with atomic fsync'd appends and a resume loader;
+* :mod:`riptide_tpu.survey.scheduler` — a work queue over DM-trial
+  chunks wrapping the pipeline's prep/ship/drain overlap, with
+  per-chunk retry (exponential backoff + jitter) and kill-and-resume;
+* :mod:`riptide_tpu.survey.faults` — env/config-driven fault injection
+  so the robustness machinery is testable on the CPU backend;
+* :mod:`riptide_tpu.survey.metrics` — lightweight counters/timers
+  threaded through the engine, batcher, pipeline and multihost layers.
+
+Submodules import the heavy engine stack, so this package namespace is
+lazy: ``riptide_tpu.survey.metrics`` is importable from the engine
+without creating an import cycle.
+"""
+
+_LAZY = {
+    "SurveyJournal": "journal",
+    "JournalMismatch": "journal",
+    "SurveyScheduler": "scheduler",
+    "RetryPolicy": "scheduler",
+    "TransientChunkError": "scheduler",
+    "FaultPlan": "faults",
+    "FaultAbort": "faults",
+    "MetricsRegistry": "metrics",
+    "get_metrics": "metrics",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,124 @@
+"""
+Pluggable fault injection for the survey scheduler.
+
+Device faults on real hardware (transient dispatch errors, corrupted
+tunnel transfers, multi-second stalls) are rare and unreproducible, so
+the scheduler's robustness machinery is exercised instead through an
+injected :class:`FaultPlan`, configured from a spec string (CLI
+``--fault-inject`` or the ``RIPTIDE_FAULT_INJECT`` environment
+variable). This keeps the retry/backoff and resume paths testable on
+the CPU backend.
+
+Spec grammar: comma-separated directives, each
+``kind:chunk[:arg][xN]`` —
+
+* ``raise:2``       raise a transient error dispatching chunk 2 (once);
+* ``raise:2x3``     ... on the first three dispatch attempts of chunk 2;
+* ``stall:1:0.5``   sleep 0.5 s before dispatching chunk 1;
+* ``corrupt:0``     flip bytes in chunk 0's prepared wire buffer (the
+  scheduler detects the digest mismatch and re-prepares);
+* ``abort:3``       raise a NON-retryable :class:`FaultAbort` on chunk 3
+  (simulates a kill/preemption: completed chunks stay journaled and a
+  ``--resume`` run picks up from there).
+
+Example: ``RIPTIDE_FAULT_INJECT="stall:0:0.1,raise:2x2"``.
+"""
+import logging
+import time
+
+__all__ = ["FaultPlan", "FaultAbort", "InjectedFault"]
+
+log = logging.getLogger("riptide_tpu.survey.faults")
+
+_KINDS = ("raise", "stall", "corrupt", "abort")
+
+
+class InjectedFault(RuntimeError):
+    """Transient injected device error (retryable)."""
+
+
+class FaultAbort(RuntimeError):
+    """Injected fatal fault (not retryable): simulates a kill."""
+
+
+class FaultPlan:
+    """Parsed fault directives, consumed as the scheduler hits their
+    trigger points. ``sleep`` is injectable for tests."""
+
+    def __init__(self, directives=(), sleep=time.sleep):
+        # directive: dict(kind, chunk, arg, remaining)
+        self._directives = [dict(d) for d in directives]
+        self._sleep = sleep
+
+    @classmethod
+    def parse(cls, spec, sleep=time.sleep):
+        """Build a plan from a spec string; None/empty -> inert plan."""
+        directives = []
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            times = 1
+            if "x" in part.rsplit(":", 1)[-1]:
+                part, _, n = part.rpartition("x")
+                times = int(n)
+            bits = part.split(":")
+            if len(bits) < 2 or bits[0] not in _KINDS:
+                raise ValueError(
+                    f"bad fault directive {part!r}: expected "
+                    f"kind:chunk[:arg][xN] with kind in {_KINDS}"
+                )
+            kind, chunk = bits[0], int(bits[1])
+            arg = float(bits[2]) if len(bits) > 2 else None
+            directives.append(
+                {"kind": kind, "chunk": chunk, "arg": arg, "remaining": times}
+            )
+        return cls(directives, sleep=sleep)
+
+    def _take(self, kind, chunk_id):
+        for d in self._directives:
+            if d["kind"] == kind and d["chunk"] == chunk_id \
+                    and d["remaining"] > 0:
+                d["remaining"] -= 1
+                return d
+        return None
+
+    # -- trigger points (called by the scheduler) ---------------------------
+
+    def before_dispatch(self, chunk_id):
+        """Called at the top of every dispatch attempt: may stall, raise
+        a transient :class:`InjectedFault`, or raise :class:`FaultAbort`."""
+        d = self._take("stall", chunk_id)
+        if d is not None:
+            secs = d["arg"] if d["arg"] is not None else 1.0
+            log.warning("fault injection: stalling %.3fs on chunk %d",
+                        secs, chunk_id)
+            self._sleep(secs)
+        if self._take("abort", chunk_id) is not None:
+            log.warning("fault injection: aborting on chunk %d", chunk_id)
+            raise FaultAbort(f"injected abort on chunk {chunk_id}")
+        if self._take("raise", chunk_id) is not None:
+            log.warning("fault injection: transient error on chunk %d",
+                        chunk_id)
+            raise InjectedFault(f"injected device error on chunk {chunk_id}")
+
+    def corrupt_wire(self, chunk_id, items):
+        """Called once per chunk after host preparation: flips the first
+        byte of each prepared wire buffer in place (detected downstream
+        by the scheduler's digest verification)."""
+        if self._take("corrupt", chunk_id) is None:
+            return False
+        hit = False
+        for item in items:
+            prepared = item[-1]
+            if isinstance(prepared, tuple) and len(prepared) == 2 \
+                    and hasattr(prepared[0], "view"):
+                buf = prepared[0]
+                flat = buf.view("uint8").reshape(-1)
+                if flat.size:
+                    flat[0] ^= 0xFF
+                    hit = True
+        if hit:
+            log.warning("fault injection: corrupted chunk %d's wire buffer",
+                        chunk_id)
+        return hit

@@ -1,0 +1,209 @@
+"""
+Append-only survey journal: the crash-safe record of completed work.
+
+Two JSONL files live in the journal directory:
+
+* ``journal.jsonl`` — one record per event: a ``header`` naming the
+  survey (an identity digest over the input files and search config,
+  so a journal cannot silently resume a different survey), one
+  ``chunk`` record per completed work unit (chunk id, input files, DM
+  values, wire digest, peak-store offsets, attempt count, timings) and
+  optional ``metrics`` snapshots.
+* ``peaks.jsonl`` — the peak store: one line per peak, eight numeric
+  fields in :data:`PEAK_FIELDS` order, full float precision (JSON
+  round-trips float64 exactly), so a resumed survey reproduces
+  byte-identical final data products.
+
+Appends are atomic at the line level: each record is a single
+``write()`` of one ``\\n``-terminated line on an ``O_APPEND`` fd,
+followed by ``fsync``. The loader tolerates a torn final line (a kill
+mid-append) by ignoring it, and reconciles every chunk record against
+the peak store: a chunk whose claimed ``[peaks_offset, peaks_offset +
+peaks_count)`` rows are missing (the process died between the two
+appends — peaks are written first to make that window detectable) is
+treated as never completed and re-dispatched by the scheduler.
+"""
+import json
+import logging
+import os
+
+from ..peak_detection import PEAK_FIELDS, PEAK_INT_FIELDS, Peak
+
+log = logging.getLogger("riptide_tpu.survey.journal")
+
+__all__ = ["SurveyJournal", "JournalMismatch", "PEAK_FIELDS"]
+
+JOURNAL_VERSION = 1
+
+
+class JournalMismatch(ValueError):
+    """The journal on disk belongs to a different survey (different
+    input files or search config)."""
+
+
+def _append_lines(path, objs):
+    """Append JSON lines in ONE write on an O_APPEND fd, fsync'd once
+    before returning — a chunk's whole peak batch costs a single
+    open/write/fsync cycle, and each line is still torn-tolerantly
+    parseable on its own."""
+    data = b"".join(
+        (json.dumps(obj, separators=(",", ":")) + "\n").encode()
+        for obj in objs
+    )
+    if not data:
+        return
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _append_line(path, obj):
+    """Single-write append of one JSON line, fsync'd before returning."""
+    _append_lines(path, [obj])
+
+
+def _read_lines(path):
+    """Parsed JSON objects of every complete line; a torn final line
+    (no trailing newline, or unparseable) is dropped."""
+    if not os.path.exists(path):
+        return []
+    with open(path, "rb") as f:
+        raw = f.read()
+    out = []
+    for i, line in enumerate(raw.split(b"\n")):
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            log.warning("%s: dropping torn record at line %d", path, i + 1)
+    return out
+
+
+def _peak_to_row(p):
+    return [int(getattr(p, f)) if f in PEAK_INT_FIELDS
+            else float(getattr(p, f)) for f in PEAK_FIELDS]
+
+
+def _row_to_peak(row):
+    kw = {f: (int(v) if f in PEAK_INT_FIELDS else float(v))
+          for f, v in zip(PEAK_FIELDS, row)}
+    return Peak(**kw)
+
+
+class SurveyJournal:
+    """
+    Parameters
+    ----------
+    directory : str
+        Journal directory (created if missing). Holds ``journal.jsonl``
+        and ``peaks.jsonl``.
+    """
+
+    def __init__(self, directory):
+        self.directory = os.path.realpath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.journal_path = os.path.join(self.directory, "journal.jsonl")
+        self.peaks_path = os.path.join(self.directory, "peaks.jsonl")
+        self._peak_rows = None  # lazily loaded peak-store line count
+
+    # -- writing ------------------------------------------------------------
+
+    def write_header(self, survey_id, chunks_total):
+        """Record the survey identity. Idempotent for a matching id; a
+        journal holding a DIFFERENT survey raises :class:`JournalMismatch`
+        rather than silently mixing two surveys' chunks."""
+        hdr = self._header()
+        if hdr is not None:
+            if hdr.get("survey_id") != survey_id:
+                raise JournalMismatch(
+                    f"journal at {self.directory!r} belongs to survey "
+                    f"{hdr.get('survey_id')!r}, not {survey_id!r}; refusing "
+                    "to resume (point --journal elsewhere or delete it)"
+                )
+            return
+        _append_line(self.journal_path, {
+            "kind": "header", "version": JOURNAL_VERSION,
+            "survey_id": survey_id, "chunks_total": int(chunks_total),
+        })
+
+    def record_chunk(self, chunk_id, files, dms, peaks, wire_digest=None,
+                     timings=None, attempts=1):
+        """Journal one completed chunk. The peak rows are appended (and
+        fsync'd) BEFORE the chunk record, so a chunk record always
+        implies its peaks are durable."""
+        offset = self._peak_store_len()
+        _append_lines(self.peaks_path, [_peak_to_row(p) for p in peaks])
+        self._peak_rows = offset + len(peaks)
+        _append_line(self.journal_path, {
+            "kind": "chunk", "chunk_id": int(chunk_id),
+            "files": [os.path.basename(f) for f in files],
+            "dms": [float(d) for d in dms],
+            "wire_digest": wire_digest,
+            "peaks_offset": offset, "peaks_count": len(peaks),
+            "timings": timings or {}, "attempts": int(attempts),
+        })
+
+    def record_metrics(self, summary):
+        """Append a metrics snapshot (see MetricsRegistry.summary)."""
+        _append_line(self.journal_path, {"kind": "metrics",
+                                         "summary": summary})
+
+    # -- reading ------------------------------------------------------------
+
+    def _records(self):
+        return _read_lines(self.journal_path)
+
+    def _header(self):
+        for rec in self._records():
+            if rec.get("kind") == "header":
+                return rec
+        return None
+
+    def _peak_store_len(self):
+        if self._peak_rows is None:
+            self._peak_rows = len(_read_lines(self.peaks_path))
+        return self._peak_rows
+
+    def survey_id(self):
+        hdr = self._header()
+        return hdr.get("survey_id") if hdr else None
+
+    def last_metrics(self):
+        """Most recent journaled metrics summary, or None."""
+        out = None
+        for rec in self._records():
+            if rec.get("kind") == "metrics":
+                out = rec.get("summary")
+        return out
+
+    def completed_chunks(self):
+        """Resume loader: ``{chunk_id: (record, [Peak, ...])}`` for every
+        chunk record whose claimed peak rows exist in the peak store.
+        Chunks with missing/torn peak rows are dropped (re-dispatched);
+        duplicate chunk ids keep the LAST record (a retried chunk's
+        final successful journaling wins)."""
+        rows = _read_lines(self.peaks_path)
+        out = {}
+        for rec in self._records():
+            if rec.get("kind") != "chunk":
+                continue
+            off, cnt = rec.get("peaks_offset", 0), rec.get("peaks_count", 0)
+            if off + cnt > len(rows):
+                log.warning(
+                    "journal chunk %s claims peak rows [%d, %d) but the "
+                    "peak store holds %d; re-dispatching it",
+                    rec.get("chunk_id"), off, off + cnt, len(rows),
+                )
+                continue
+            try:
+                peaks = [_row_to_peak(r) for r in rows[off : off + cnt]]
+            except (TypeError, ValueError):
+                log.warning("journal chunk %s has malformed peak rows; "
+                            "re-dispatching it", rec.get("chunk_id"))
+                continue
+            out[int(rec["chunk_id"])] = (rec, peaks)
+        return out

@@ -1,0 +1,130 @@
+"""
+Lightweight metrics registry for survey runs.
+
+Counters (monotonic sums), timers (accumulated seconds + call counts)
+and gauges (last-set values), all behind one lock, with a process-wide
+default registry reachable from any layer via :func:`get_metrics`. The
+engine, batcher, pipeline and multihost layers record into it
+unconditionally — recording is two dict operations under a lock, cheap
+next to anything they instrument — and the survey scheduler snapshots
+it into the journal; ``bench.py`` emits the same snapshot as a
+machine-readable block next to its headline JSON line.
+
+Metric names used by the framework (all optional — a snapshot simply
+contains whatever was recorded):
+
+========================  ====================================================
+``prep_s``                timer: host wire preparation (downsample + quantise)
+``wire_s``                timer: host->device transfer of prepared wire data
+``wire_bytes``            counter: bytes shipped over the wire
+``device_s``              timer: blocking waits on queued device work
+``chunk_s``               timer: whole-chunk wall time in the scheduler/bench
+``gather_s``              timer: multihost peak all-gathers
+``chunks_done``           counter: chunks searched to completion
+``chunks_retried``        counter: chunk dispatch attempts beyond the first
+``chunks_skipped``        counter: chunks satisfied from the journal on resume
+``queue_depth``           gauge: work items not yet collected
+========================  ====================================================
+
+Derived rates (e.g. ``wire_MBps``) are computed by :meth:`summary`, not
+stored.
+"""
+import threading
+import time
+from contextlib import contextmanager
+
+__all__ = ["MetricsRegistry", "get_metrics", "set_metrics"]
+
+
+class MetricsRegistry:
+    """Thread-safe counters/timers/gauges with dict snapshots."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._timers = {}  # name -> [total_seconds, count]
+        self._gauges = {}
+
+    # -- recording ----------------------------------------------------------
+
+    def add(self, name, value=1):
+        """Increment counter ``name`` by ``value``."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def observe(self, name, seconds):
+        """Accumulate ``seconds`` into timer ``name``."""
+        with self._lock:
+            t = self._timers.setdefault(name, [0.0, 0])
+            t[0] += float(seconds)
+            t[1] += 1
+
+    @contextmanager
+    def timer(self, name):
+        """Context manager observing the enclosed block's wall time."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    def set_gauge(self, name, value):
+        with self._lock:
+            self._gauges[name] = value
+
+    # -- reading ------------------------------------------------------------
+
+    def counter(self, name, default=0):
+        with self._lock:
+            return self._counters.get(name, default)
+
+    def snapshot(self):
+        """Raw state: ``{"counters": {...}, "timers": {name: {"total_s",
+        "count"}}, "gauges": {...}}``. Values are plain JSON types."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "timers": {
+                    k: {"total_s": round(v[0], 6), "count": v[1]}
+                    for k, v in self._timers.items()
+                },
+                "gauges": dict(self._gauges),
+            }
+
+    def summary(self):
+        """Flat dict of headline sub-metrics with derived rates: every
+        counter and gauge verbatim, every timer as ``<name>`` total
+        seconds, plus ``wire_MBps`` (wire_bytes / wire_s) when both were
+        recorded. This is the block the journal and ``bench.py`` emit."""
+        snap = self.snapshot()
+        out = {}
+        out.update(snap["counters"])
+        out.update(snap["gauges"])
+        for k, v in snap["timers"].items():
+            out[k] = round(v["total_s"], 6)
+        wire_s = out.get("wire_s")
+        wire_bytes = out.get("wire_bytes")
+        if wire_s and wire_bytes:
+            out["wire_MBps"] = round(wire_bytes / 1e6 / wire_s, 3)
+        return out
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+            self._gauges.clear()
+
+
+_default = MetricsRegistry()
+
+
+def get_metrics():
+    """The process-wide default registry."""
+    return _default
+
+
+def set_metrics(registry):
+    """Replace the default registry (tests); returns the previous one."""
+    global _default
+    prev, _default = _default, registry
+    return prev

@@ -1,0 +1,289 @@
+"""
+Checkpointed work-queue scheduler over DM-trial chunks.
+
+Wraps the pipeline's :class:`~riptide_tpu.pipeline.batcher.BatchSearcher`
+chunk machinery (host load/detrend/wire-prep, ship, device dispatch,
+collect) in a resumable queue:
+
+* chunks already recorded in the :class:`SurveyJournal` are skipped and
+  their peaks replayed from the journal's peak store (kill-and-resume);
+* each pending chunk's device dispatch runs under per-chunk **retry
+  with exponential backoff + jitter**: a transient device error (or an
+  injected one) re-dispatches the chunk, re-preparing from the host
+  data when the prepared wire buffer's digest no longer matches (a
+  corrupted transfer);
+* chunk i+1's host preparation overlaps chunk i's device execution on a
+  dedicated staging thread, preserving the batcher's prep/compute
+  overlap (the collect round trip is paid per chunk — the price of a
+  durable checkpoint after every chunk);
+* completed chunks append to the journal (peaks first, then the chunk
+  record — both fsync'd) so a kill at any instant loses at most the
+  in-flight chunk.
+
+Fault injection (:mod:`riptide_tpu.survey.faults`) hooks the dispatch
+path so all of the above is testable on the CPU backend.
+"""
+import hashlib
+import logging
+import os
+import random
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from .faults import FaultAbort, FaultPlan
+from .metrics import get_metrics
+
+log = logging.getLogger("riptide_tpu.survey.scheduler")
+
+__all__ = ["SurveyScheduler", "RetryPolicy", "TransientChunkError",
+           "survey_identity", "run_with_retry"]
+
+
+class TransientChunkError(RuntimeError):
+    """A chunk dispatch failed in a way worth retrying (e.g. the
+    prepared wire buffer's digest no longer matches)."""
+
+
+class RetryPolicy:
+    """Exponential backoff with jitter around per-chunk device dispatch.
+
+    Delay before retry ``k`` (0-based) is ``min(cap_s, base_s * 2**k)``
+    scaled by a uniform jitter in ``[1 - jitter, 1 + jitter]`` — jitter
+    decorrelates retry storms when many hosts share a flaky
+    interconnect. ``sleep``/``rng`` are injectable for tests.
+    """
+
+    def __init__(self, max_retries=3, base_s=0.25, cap_s=8.0, jitter=0.5,
+                 sleep=time.sleep, rng=None):
+        self.max_retries = int(max_retries)
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.jitter = float(jitter)
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+
+    def delay(self, attempt):
+        """Backoff delay in seconds before retry ``attempt`` (0-based)."""
+        d = min(self.cap_s, self.base_s * (2.0 ** attempt))
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(d, 0.0)
+
+    def backoff(self, attempt):
+        self._sleep(self.delay(attempt))
+
+
+def survey_identity(files, config=None):
+    """Stable digest naming a survey: input file basenames (order
+    matters — it defines chunk ids) plus the search-relevant config."""
+    import json
+
+    h = hashlib.sha1()
+    for f in files:
+        h.update(os.path.basename(str(f)).encode())
+        h.update(b"\0")
+    if config is not None:
+        h.update(json.dumps(config, sort_keys=True, default=str).encode())
+    return h.hexdigest()
+
+
+def run_with_retry(work, chunk_id, retry, faults, metrics, on_retry=None):
+    """The ONE retry/backoff loop around a work unit's dispatch, shared
+    by the chunk scheduler and the rseek CLI: fires the fault plan's
+    dispatch trigger, runs ``work()``, and on a retryable failure backs
+    off, bumps ``chunks_retried``, calls ``on_retry`` (recovery hook,
+    e.g. re-preparing a corrupted buffer) and tries again.
+    :class:`FaultAbort` and exhausted retries propagate. Returns
+    ``(result, attempts)``."""
+    attempt = 0
+    while True:
+        try:
+            faults.before_dispatch(chunk_id)
+            return work(), attempt + 1
+        except FaultAbort:
+            raise
+        except Exception as err:
+            if attempt >= retry.max_retries:
+                log.error("chunk %d failed after %d attempts: %s",
+                          chunk_id, attempt + 1, err)
+                raise
+            metrics.add("chunks_retried")
+            delay = retry.delay(attempt)
+            log.warning(
+                "chunk %d dispatch failed (%s); retry %d/%d in %.2fs",
+                chunk_id, err, attempt + 1, retry.max_retries, delay,
+            )
+            retry._sleep(delay)
+            if on_retry is not None:
+                on_retry()
+            attempt += 1
+
+
+def _wire_digest(items):
+    """sha1 over every prepared wire buffer of a chunk's work items;
+    None when the prepared form is not a host (array, meta) pair (the
+    mesh-sharded path ships per-shard structures)."""
+    h = hashlib.sha1()
+    seen = False
+    for item in items:
+        prepared = item[-1]
+        if isinstance(prepared, tuple) and len(prepared) == 2 \
+                and hasattr(prepared[0], "tobytes"):
+            h.update(prepared[0].tobytes())
+            scales = prepared[1].get("scales") if isinstance(prepared[1], dict) else None
+            if scales is not None:
+                h.update(scales.tobytes())
+            seen = True
+    return h.hexdigest() if seen else None
+
+
+class SurveyScheduler:
+    """
+    Parameters
+    ----------
+    searcher : BatchSearcher
+        Configured batch searcher (the scheduler drives its chunk
+        stages directly).
+    chunks : list of list of str
+        DM-trial filename chunks, in survey order (defines chunk ids).
+    journal : SurveyJournal or None
+        When given, completed chunks are checkpointed and — with
+        ``resume=True`` — replayed.
+    resume : bool
+        Skip chunks already journaled (requires ``journal``).
+    retry : RetryPolicy or None
+    faults : FaultPlan or None
+    survey_id : str or None
+        Identity recorded in the journal header; defaults to a digest
+        of the chunk filenames.
+    metrics : MetricsRegistry or None
+        Defaults to the process-wide registry.
+    """
+
+    def __init__(self, searcher, chunks, journal=None, resume=False,
+                 retry=None, faults=None, survey_id=None, metrics=None):
+        self.searcher = searcher
+        self.chunks = [list(c) for c in chunks]
+        self.journal = journal
+        self.resume = bool(resume)
+        self.retry = retry or RetryPolicy()
+        self.faults = faults or FaultPlan()
+        self.metrics = metrics or get_metrics()
+        if survey_id is None:
+            survey_id = survey_identity([f for c in self.chunks for f in c])
+        self.survey_id = survey_id
+
+    # -- staging ------------------------------------------------------------
+
+    def _stage(self, loaders, fnames):
+        """Host half of one chunk: load + detrend + wire-prep. Returns
+        (tslist, items, digest) — tslist is retained so a corrupted
+        chunk can be re-prepared without re-reading files."""
+        with self.metrics.timer("chunk_prep_s"):
+            tslist = list(loaders.map(self.searcher.load_prepared, fnames))
+            items = self.searcher._prepare_chunk(tslist)
+        return tslist, items, _wire_digest(items)
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _dispatch_once(self, chunk_id, items, digest):
+        """One dispatch attempt: digest check, ship, queue, collect.
+        (The fault plan's dispatch trigger fires in run_with_retry.)"""
+        if digest is not None and _wire_digest(items) != digest:
+            raise TransientChunkError(
+                f"chunk {chunk_id}: prepared wire buffer digest mismatch "
+                "(corrupted transfer buffer)"
+            )
+        shipped = self.searcher._ship_chunk(items)
+        queued = self.searcher._queue_chunk(shipped)
+        return self.searcher._collect_chunk(queued)
+
+    def _dispatch_with_retry(self, chunk_id, tslist, items, digest):
+        """One chunk's device dispatch under :func:`run_with_retry`,
+        with a recovery hook that re-prepares the chunk from the
+        retained host data when the prepared buffer was corrupted.
+        Returns (peaks, attempts, digest)."""
+        state = {"items": items, "digest": digest}
+
+        def work():
+            return self._dispatch_once(chunk_id, state["items"],
+                                       state["digest"])
+
+        def recover():
+            if state["digest"] is not None \
+                    and _wire_digest(state["items"]) != state["digest"]:
+                # Corrupted prepared buffer: rebuild from host data.
+                with self.metrics.timer("chunk_prep_s"):
+                    state["items"] = self.searcher._prepare_chunk(tslist)
+                state["digest"] = _wire_digest(state["items"])
+
+        peaks, attempts = run_with_retry(
+            work, chunk_id, self.retry, self.faults, self.metrics,
+            on_retry=recover,
+        )
+        return peaks, attempts, state["digest"]
+
+    # -- main loop ----------------------------------------------------------
+
+    def run(self):
+        """Process every chunk; returns the flat Peak list in chunk
+        order (journal-replayed and freshly-searched chunks interleave
+        exactly as an uninterrupted run would produce them)."""
+        done = {}
+        if self.journal is not None:
+            self.journal.write_header(self.survey_id, len(self.chunks))
+            if self.resume:
+                for cid, (rec, peaks) in self.journal.completed_chunks().items():
+                    if cid >= len(self.chunks):
+                        continue
+                    expect = [os.path.basename(f) for f in self.chunks[cid]]
+                    if rec.get("files") != expect:
+                        log.warning("journal chunk %d names %s, expected %s; "
+                                    "re-dispatching", cid, rec.get("files"),
+                                    expect)
+                        continue
+                    done[cid] = peaks
+                if done:
+                    log.info("resuming: %d/%d chunks replayed from journal",
+                             len(done), len(self.chunks))
+                self.metrics.add("chunks_skipped", len(done))
+
+        pending = [i for i in range(len(self.chunks)) if i not in done]
+        peaks_by_chunk = dict(done)
+        with ThreadPoolExecutor(max_workers=1) as stager, \
+                ThreadPoolExecutor(max_workers=self.searcher.io_threads) \
+                as loaders:
+            staged = (stager.submit(self._stage, loaders,
+                                    self.chunks[pending[0]])
+                      if pending else None)
+            for k, cid in enumerate(pending):
+                self.metrics.set_gauge("queue_depth", len(pending) - k)
+                tslist, items, digest = staged.result()
+                if k + 1 < len(pending):
+                    staged = stager.submit(
+                        self._stage, loaders, self.chunks[pending[k + 1]]
+                    )
+                t0 = time.perf_counter()
+                self.faults.corrupt_wire(cid, items)
+                peaks, attempts, digest = self._dispatch_with_retry(
+                    cid, tslist, items, digest
+                )
+                chunk_s = time.perf_counter() - t0
+                self.metrics.observe("chunk_s", chunk_s)
+                self.metrics.add("chunks_done")
+                peaks_by_chunk[cid] = peaks
+                if self.journal is not None:
+                    self.journal.record_chunk(
+                        cid, self.chunks[cid],
+                        [float(ts.metadata["dm"] or 0.0) for ts in tslist],
+                        peaks, wire_digest=digest,
+                        timings={"chunk_s": round(chunk_s, 6)},
+                        attempts=attempts,
+                    )
+                log.debug("chunk %d/%d done: %d peaks, %d attempt(s)",
+                          cid + 1, len(self.chunks), len(peaks), attempts)
+        self.metrics.set_gauge("queue_depth", 0)
+        if self.journal is not None:
+            self.journal.record_metrics(self.metrics.summary())
+        return [p for cid in sorted(peaks_by_chunk)
+                for p in peaks_by_chunk[cid]]

@@ -23,11 +23,14 @@ cache in ops/ffa_kernel so only semantic kernel changes invalidate it.
 """
 import functools
 import hashlib
+import json
 import logging
 import os
 import pickle
+import stat
 import tempfile
 import threading
+import time
 
 import jax
 
@@ -36,26 +39,94 @@ log = logging.getLogger("riptide_tpu.exec_cache")
 __all__ = ["cached_jit", "load_or_compile_exec", "cache_root"]
 
 
-def cache_root():
+def _dir_trusted(path):
+    """Whether a pre-existing cache directory is safe to load pickles
+    from: a real directory (not a symlink), owned by us, with no
+    group/other write bits, whose parent cannot be used to replace the
+    directory wholesale — i.e. the parent is not world-writable, unless
+    it has the sticky bit set (/tmp's 1777: others can neither delete
+    nor rename our entry there)."""
+    try:
+        st = os.lstat(path)
+        parent_st = os.lstat(os.path.dirname(path) or ".")
+    except OSError:
+        return False
+    if not stat.S_ISDIR(st.st_mode):
+        return False
+    if st.st_uid != os.getuid():
+        return False
+    if st.st_mode & (stat.S_IWGRP | stat.S_IWOTH):
+        return False
+    if (parent_st.st_mode & stat.S_IWOTH
+            and not parent_st.st_mode & stat.S_ISVTX):
+        return False
+    return True
+
+
+def _user_tmp_cache():
+    """Per-user 0700 tempdir fallback (entries are pickles: the
+    directory must not be writable — or squattable — by other users).
+    If the canonical per-uid name was squatted by someone else, caching
+    there would execute their pickles; use a fresh ``mkdtemp`` instead
+    (safe, at the price of a cold cache for this process tree)."""
+    path = os.path.join(tempfile.gettempdir(),
+                        f"riptide_tpu_cache_{os.getuid()}")
+    try:
+        os.makedirs(path, mode=0o700, exist_ok=True)
+    except OSError as err:
+        log.warning("could not create tempdir cache %r (%s)", path, err)
+    if _dir_trusted(path):
+        return path
+    try:
+        fallback = tempfile.mkdtemp(prefix="riptide_tpu_cache_")
+        log.warning(
+            "tempdir cache %r failed the ownership/permission check "
+            "(squatted or over-permissioned); using fresh %r instead",
+            path, fallback,
+        )
+        return fallback
+    except OSError as err:
+        log.warning("could not create fallback cache dir (%s)", err)
+        return path
+
+
+def cache_root(checkout_dir=None):
     """Root directory for the on-disk executable caches.
 
-    Precedence: ``RIPTIDE_CACHE_ROOT``; a ``.riptide_cache`` directory
-    at the checkout root (the package's parent) when that location is
-    writable — unlike a tempdir it is guaranteed to survive into every
-    later process run from the same checkout, in particular the
-    driver's end-of-round benchmark run; else a per-user tempdir
-    (0700: entries are pickles, the directory must not be writable by
-    other local users)."""
+    Precedence: ``RIPTIDE_CACHE_ROOT`` (explicit operator intent, used
+    as given); a ``.riptide_cache`` directory at the checkout root (the
+    package's parent) — unlike a tempdir it is guaranteed to survive
+    into every later process run from the same checkout, in particular
+    the driver's end-of-round benchmark run; else a per-user 0700
+    tempdir. Cache entries are pickles executed at load time, so a
+    PRE-EXISTING ``.riptide_cache`` is trusted only when it passes
+    :func:`_dir_trusted` (ours, not group/other-writable, parent not
+    world-writable); a spoofed or over-permissioned directory falls
+    back to the tempdir instead of being loaded from."""
     env = os.environ.get("RIPTIDE_CACHE_ROOT")
     if env:
         return env
-    repo = os.path.dirname(
+    repo = checkout_dir or os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
-    if os.access(repo, os.W_OK):
-        return os.path.join(repo, ".riptide_cache")
-    return os.path.join(tempfile.gettempdir(),
-                        f"riptide_tpu_cache_{os.getuid()}")
+    cand = os.path.join(repo, ".riptide_cache")
+    if os.path.lexists(cand):
+        if _dir_trusted(cand):
+            return cand
+        log.warning(
+            "%r exists but is not a directory owned by uid %d with "
+            "group/other write bits clear (or its parent is "
+            "world-writable); falling back to the per-user tempdir cache",
+            cand, os.getuid(),
+        )
+        return _user_tmp_cache()
+    try:
+        repo_st = os.lstat(repo)
+    except OSError:
+        return _user_tmp_cache()
+    if os.access(repo, os.W_OK) and not (repo_st.st_mode & stat.S_IWOTH):
+        return cand
+    return _user_tmp_cache()
 
 
 _DIR = os.environ.get(
@@ -64,6 +135,105 @@ _DIR = os.environ.get(
 
 _lock = threading.Lock()
 _src_hash_memo = None
+
+
+# ---------------------------------------------------------------------------
+# Size-capped LRU eviction.
+#
+# Compiled-executable pickles are tens of MB each and the cache keys
+# include a whole-package source hash, so a long-lived checkout
+# accumulates dead generations without bound. Each cache directory
+# keeps a manifest of {entry: {bytes, last_used}}; inserts evict the
+# least-recently-used entries until the directory fits the byte cap,
+# and loads refresh last_used so warm entries survive. The manifest is
+# advisory — corruption or concurrent writers at worst evict
+# suboptimally, never break correctness (a missing entry recompiles).
+# ---------------------------------------------------------------------------
+
+_MANIFEST = "manifest.json"
+_lru_lock = threading.Lock()
+
+
+def _cache_cap_bytes():
+    """Byte cap per cache directory (default 2 GiB); <= 0 disables
+    eviction."""
+    return int(os.environ.get("RIPTIDE_EXEC_CACHE_MAX_BYTES", 2 << 30))
+
+
+def _manifest_scan(d):
+    """Rebuild manifest state from the directory contents (mtime as the
+    initial last-used ordering)."""
+    entries = {}
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return entries
+    for name in names:
+        if not name.endswith(".pkl"):
+            continue
+        try:
+            st = os.stat(os.path.join(d, name))
+        except OSError:
+            continue
+        entries[name] = {"bytes": int(st.st_size),
+                         "last_used": float(st.st_mtime)}
+    return entries
+
+
+def _manifest_load(d):
+    try:
+        with open(os.path.join(d, _MANIFEST)) as f:
+            m = json.load(f)
+        if isinstance(m, dict) and all(
+            isinstance(v, dict) and "bytes" in v and "last_used" in v
+            for v in m.values()
+        ):
+            return m
+    except (OSError, ValueError):
+        pass
+    return _manifest_scan(d)
+
+
+def _manifest_write(d, m):
+    try:
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump(m, f)
+        os.replace(tmp, os.path.join(d, _MANIFEST))
+    except OSError as err:
+        log.debug("manifest write failed in %s (%s)", d, err)
+
+
+def _lru_note(path, inserted):
+    """Record a cache hit (``inserted=False``: refresh last_used) or a
+    new entry (``inserted=True``: register it, then evict the oldest
+    entries past the byte cap, never the one just inserted)."""
+    d, name = os.path.split(path)
+    with _lru_lock:
+        m = _manifest_load(d)
+        try:
+            size = int(os.stat(path).st_size)
+        except OSError:
+            return
+        m[name] = {"bytes": size, "last_used": time.time()}
+        if inserted:
+            cap = _cache_cap_bytes()
+            if cap > 0:
+                victims = sorted(
+                    (k for k in m if k != name),
+                    key=lambda k: m[k]["last_used"],
+                )
+                total = sum(v["bytes"] for v in m.values())
+                for k in victims:
+                    if total <= cap:
+                        break
+                    try:
+                        os.remove(os.path.join(d, k))
+                    except OSError:
+                        pass
+                    total -= m.pop(k)["bytes"]
+                    log.info("evicted LRU executable-cache entry %s", k)
+        _manifest_write(d, m)
 
 
 def _src_hash():
@@ -102,7 +272,9 @@ def load_or_compile_exec(path, jitted, args, kw=None, name="program",
             with open(path, "rb") as f:
                 payload, in_tree, out_tree = pickle.load(f)
             info["action"] = "loaded"
-            return se.deserialize_and_load(payload, in_tree, out_tree)
+            loaded = se.deserialize_and_load(payload, in_tree, out_tree)
+            _lru_note(path, inserted=False)
+            return loaded
         except Exception as err:
             log.warning("exec cache load failed for %s (%s); recompiling",
                         name, err)
@@ -116,6 +288,7 @@ def load_or_compile_exec(path, jitted, args, kw=None, name="program",
         with os.fdopen(fd, "wb") as f:
             pickle.dump(payload, f)
         os.replace(tmp, path)
+        _lru_note(path, inserted=True)
     except Exception as err:
         log.warning("exec cache store failed for %s (%s)", name, err)
     return compiled

@@ -106,3 +106,199 @@ def test_off_switch(monkeypatch):
 
     np.testing.assert_allclose(np.asarray(f(jnp.arange(3.0))), [0, 2, 4])
     assert not f._mem  # bypassed entirely
+
+
+# ---------------------------------------------------------------------------
+# cache_root hardening: a pre-existing .riptide_cache is only trusted
+# when it is ours and not writable (or replaceable) by other users —
+# entries are pickles executed at load time.
+# ---------------------------------------------------------------------------
+
+def _make_checkout(tmp_path):
+    repo = tmp_path / "checkout"
+    repo.mkdir(mode=0o755)
+    return repo
+
+
+def test_cache_root_env_override_wins(tmp_path, monkeypatch):
+    monkeypatch.setenv("RIPTIDE_CACHE_ROOT", str(tmp_path / "explicit"))
+    assert exec_cache.cache_root() == str(tmp_path / "explicit")
+
+
+def test_cache_root_accepts_owned_0700_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("RIPTIDE_CACHE_ROOT", raising=False)
+    repo = _make_checkout(tmp_path)
+    cand = repo / ".riptide_cache"
+    cand.mkdir(mode=0o700)
+    assert exec_cache.cache_root(str(repo)) == str(cand)
+
+
+def test_cache_root_rejects_group_other_writable_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("RIPTIDE_CACHE_ROOT", raising=False)
+    repo = _make_checkout(tmp_path)
+    cand = repo / ".riptide_cache"
+    cand.mkdir(mode=0o777)  # spoofed: anyone can plant pickles
+    import os as _os
+
+    _os.chmod(cand, 0o777)  # bypass umask
+    root = exec_cache.cache_root(str(repo))
+    assert root != str(cand)
+    assert f"riptide_tpu_cache_{_os.getuid()}" in root
+
+
+def test_cache_root_rejects_symlinked_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("RIPTIDE_CACHE_ROOT", raising=False)
+    repo = _make_checkout(tmp_path)
+    elsewhere = tmp_path / "elsewhere"
+    elsewhere.mkdir(mode=0o700)
+    (repo / ".riptide_cache").symlink_to(elsewhere)
+    root = exec_cache.cache_root(str(repo))
+    assert root != str(repo / ".riptide_cache")
+
+
+def test_cache_root_rejects_world_writable_parent(tmp_path, monkeypatch):
+    monkeypatch.delenv("RIPTIDE_CACHE_ROOT", raising=False)
+    import os as _os
+
+    repo = _make_checkout(tmp_path)
+    cand = repo / ".riptide_cache"
+    cand.mkdir(mode=0o700)
+    _os.chmod(repo, 0o777)  # any user may swap the cache dir wholesale
+    try:
+        root = exec_cache.cache_root(str(repo))
+        assert root != str(cand)
+    finally:
+        _os.chmod(repo, 0o755)
+
+
+def test_cache_root_fresh_checkout_uses_repo_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("RIPTIDE_CACHE_ROOT", raising=False)
+    repo = _make_checkout(tmp_path)
+    assert exec_cache.cache_root(str(repo)) == str(repo / ".riptide_cache")
+
+
+def test_dir_trusted_accepts_sticky_world_writable_parent(tmp_path):
+    """/tmp-style parents (1777) are fine: the sticky bit stops other
+    users replacing our entry even though the parent is world-writable."""
+    import os as _os
+
+    parent = tmp_path / "tmplike"
+    parent.mkdir()
+    _os.chmod(parent, 0o1777)
+    d = parent / "cache"
+    d.mkdir(mode=0o700)
+    assert exec_cache._dir_trusted(str(d))
+    _os.chmod(parent, 0o777)  # same but sticky cleared: replaceable
+    assert not exec_cache._dir_trusted(str(d))
+
+
+def test_user_tmp_cache_avoids_squatted_dir(tmp_path, monkeypatch):
+    """A squatted/over-permissioned per-uid tempdir must NOT be used for
+    pickle caching; a fresh private directory is created instead."""
+    import os as _os
+
+    monkeypatch.setattr(exec_cache.tempfile, "gettempdir",
+                        lambda: str(tmp_path))
+    squat = tmp_path / f"riptide_tpu_cache_{_os.getuid()}"
+    squat.mkdir()
+    _os.chmod(squat, 0o777)
+    path = exec_cache._user_tmp_cache()
+    assert path != str(squat)
+    assert exec_cache._dir_trusted(path) or _os.path.isdir(path)
+
+
+# ---------------------------------------------------------------------------
+# Size-capped LRU eviction.
+# ---------------------------------------------------------------------------
+
+def _put_entry(d, name, nbytes, last_used=None):
+    import os as _os
+    import time as _time
+
+    path = d / name
+    path.write_bytes(b"x" * nbytes)
+    if last_used is not None:
+        _os.utime(path, (last_used, last_used))
+    else:
+        last_used = _time.time()
+    return path
+
+
+def test_lru_eviction_drops_oldest_past_cap(tmp_path, monkeypatch):
+    monkeypatch.setenv("RIPTIDE_EXEC_CACHE_MAX_BYTES", "250")
+    d = tmp_path / "exec"
+    d.mkdir()
+    _put_entry(d, "old.pkl", 100, last_used=1000.0)
+    _put_entry(d, "mid.pkl", 100, last_used=2000.0)
+    new = _put_entry(d, "new.pkl", 100)
+    exec_cache._lru_note(str(new), inserted=True)
+    # 300 bytes > 250 cap: the LRU entry goes, the newer two stay.
+    assert not (d / "old.pkl").exists()
+    assert (d / "mid.pkl").exists() and (d / "new.pkl").exists()
+
+
+def test_lru_touch_on_load_protects_warm_entries(tmp_path, monkeypatch):
+    monkeypatch.setenv("RIPTIDE_EXEC_CACHE_MAX_BYTES", "250")
+    d = tmp_path / "exec"
+    d.mkdir()
+    warm = _put_entry(d, "warm.pkl", 100, last_used=1000.0)
+    _put_entry(d, "cold.pkl", 100, last_used=2000.0)
+    # A load refreshes warm.pkl's last_used past cold.pkl's...
+    exec_cache._lru_note(str(warm), inserted=False)
+    new = _put_entry(d, "new.pkl", 100)
+    exec_cache._lru_note(str(new), inserted=True)
+    # ...so the eviction takes cold.pkl even though warm.pkl is older
+    # on disk.
+    assert (d / "warm.pkl").exists()
+    assert not (d / "cold.pkl").exists()
+
+
+def test_lru_never_evicts_just_inserted_entry(tmp_path, monkeypatch):
+    monkeypatch.setenv("RIPTIDE_EXEC_CACHE_MAX_BYTES", "50")
+    d = tmp_path / "exec"
+    d.mkdir()
+    new = _put_entry(d, "big.pkl", 100)  # alone over the cap
+    exec_cache._lru_note(str(new), inserted=True)
+    assert (d / "big.pkl").exists()
+
+
+def test_lru_survives_corrupt_manifest(tmp_path, monkeypatch):
+    monkeypatch.setenv("RIPTIDE_EXEC_CACHE_MAX_BYTES", "150")
+    d = tmp_path / "exec"
+    d.mkdir()
+    (d / exec_cache._MANIFEST).write_text("{not json")
+    _put_entry(d, "old.pkl", 100, last_used=1000.0)
+    new = _put_entry(d, "new.pkl", 100)
+    exec_cache._lru_note(str(new), inserted=True)  # rebuilds from scandir
+    assert not (d / "old.pkl").exists()
+    assert (d / "new.pkl").exists()
+
+
+def test_aot_store_and_warm_load_with_lru(monkeypatch, tmp_path):
+    """End to end through load_or_compile_exec: the store registers the
+    entry in the manifest; a second call loads (not recompiles) and
+    refreshes last_used — warm-load behaviour intact under the cap."""
+    import json
+    import os as _os
+
+    import jax
+
+    monkeypatch.setenv("RIPTIDE_EXEC_CACHE_MAX_BYTES", str(1 << 30))
+    jitted = jax.jit(lambda x: x + 1)
+    path = str(tmp_path / "entry.pkl")
+    args = (jnp.zeros(4),)
+
+    info = {}
+    exec_cache.load_or_compile_exec(path, jitted, args, info=info)
+    assert info["action"] == "compiled"
+    manifest = json.loads((tmp_path / exec_cache._MANIFEST).read_text())
+    assert "entry.pkl" in manifest
+    t0 = manifest["entry.pkl"]["last_used"]
+
+    info = {}
+    fn = exec_cache.load_or_compile_exec(path, jitted, args, info=info)
+    assert info["action"] == "loaded"
+    np.testing.assert_allclose(np.asarray(fn(jnp.zeros(4))), [1, 1, 1, 1])
+    manifest = json.loads((tmp_path / exec_cache._MANIFEST).read_text())
+    assert manifest["entry.pkl"]["last_used"] >= t0
+    assert _os.path.exists(path)

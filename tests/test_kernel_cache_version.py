@@ -1,0 +1,63 @@
+"""
+KERNEL_CACHE_VERSION guard (riptide_tpu/ops/ffa_kernel.py).
+
+The Pallas cycle-kernel executable cache is keyed by an explicit
+version constant, not file contents, so warmed entries survive source
+edits — which makes a semantic edit WITHOUT a version bump silently
+serve stale executables that compute wrong numbers. This test pins the
+bytecode digest of everything the version constant vouches for (the
+kernel body, its packing helpers, and slottables' table builders) per
+Python version: change any of their bodies and it fails until either
+KERNEL_CACHE_VERSION is bumped and tools/update_kernel_digest.py
+re-pins, or the edit is reverted. Docstring/comment edits and local
+renames do not change the digest (matching the "no bump needed"
+contract in the constant's comment).
+"""
+import json
+import os
+import sys
+
+import pytest
+
+from riptide_tpu.ops.ffa_kernel import (
+    KERNEL_CACHE_VERSION, kernel_code_digest,
+)
+
+DIGEST_FILE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "riptide_tpu", "ops", "kernel_digest.json",
+)
+
+
+def _pinned():
+    with open(DIGEST_FILE) as f:
+        data = json.load(f)
+    py = f"{sys.version_info[0]}.{sys.version_info[1]}"
+    return py, data["digests"].get(py)
+
+
+def test_kernel_digest_pinned_for_this_python():
+    py, entry = _pinned()
+    if entry is None:
+        pytest.skip(
+            f"no pinned kernel digest for python {py}; run "
+            "tools/update_kernel_digest.py to add one"
+        )
+    assert entry["kernel_cache_version"] == KERNEL_CACHE_VERSION, (
+        "kernel_digest.json pins KERNEL_CACHE_VERSION="
+        f"{entry['kernel_cache_version']} but the code has "
+        f"{KERNEL_CACHE_VERSION}; run tools/update_kernel_digest.py"
+    )
+    assert entry["digest"] == kernel_code_digest(), (
+        "the kernel/table-builder code bodies changed but "
+        f"KERNEL_CACHE_VERSION is still {KERNEL_CACHE_VERSION}. A stale "
+        "cached kernel executable with a mismatched table layout computes "
+        "WRONG NUMBERS, not a crash: bump KERNEL_CACHE_VERSION in "
+        "riptide_tpu/ops/ffa_kernel.py and re-pin with "
+        "tools/update_kernel_digest.py (or revert the edit if it was "
+        "not meant to be semantic)"
+    )
+
+
+def test_kernel_digest_stable_within_process():
+    assert kernel_code_digest() == kernel_code_digest()

@@ -50,9 +50,9 @@ def test_cluster1d_unsorted_input_indices_into_original():
     assert groups == [[0, 2], [1, 3]]
 
 
-def test_cluster1d_already_sorted_flag():
+def test_cluster1d_assume_sorted_flag():
     x = np.array([0.0, 0.1, 2.0])
-    out = cluster1d(x, 0.5, already_sorted=True)
+    out = cluster1d(x, 0.5, assume_sorted=True)
     groups = [sorted(g.tolist()) for g in out]
     assert groups == [[0, 1], [2]]
 

@@ -1,0 +1,535 @@
+"""
+Survey subsystem tests: journal durability and reconciliation, metrics
+registry, fault-injection plans, retry/backoff, scheduler
+kill-and-resume (byte-identical data products), and the CLI surfaces.
+
+Everything runs on the CPU backend against tiny synthetic surveys
+(16 s @ 1 ms, 64-71 phase bins): the machinery under test is the
+checkpoint/retry plumbing, not the search itself.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from riptide_tpu.survey.faults import FaultAbort, FaultPlan, InjectedFault
+from riptide_tpu.survey.journal import JournalMismatch, SurveyJournal
+from riptide_tpu.survey.metrics import MetricsRegistry, get_metrics
+from riptide_tpu.survey.scheduler import (
+    RetryPolicy, SurveyScheduler, survey_identity,
+)
+from riptide_tpu.peak_detection import Peak
+
+from synth import generate_data_presto
+
+TOBS = 16.0
+TSAMP = 1e-3
+PERIOD = 0.5
+# At 16 s the S/N of an amplitude-A pulse is ~A/3 here: DM 10 clears
+# the snr_min=7 candidate filter comfortably, the others do not.
+AMPLITUDES = {0.0: 15.0, 10.0: 40.0, 20.0: 15.0}
+
+
+def _peak(period=0.5, snr=10.0, dm=0.0):
+    return Peak(period=period, freq=1.0 / period, width=3, ducy=0.05,
+                iw=1, ip=7, snr=snr, dm=dm)
+
+
+# ---------------------------------------------------------------- metrics
+
+def test_metrics_counters_timers_gauges():
+    m = MetricsRegistry()
+    m.add("chunks_done")
+    m.add("chunks_done", 2)
+    m.observe("device_s", 1.5)
+    with m.timer("prep_s"):
+        pass
+    m.set_gauge("queue_depth", 4)
+    snap = m.snapshot()
+    assert snap["counters"]["chunks_done"] == 3
+    assert snap["timers"]["device_s"] == {"total_s": 1.5, "count": 1}
+    assert snap["timers"]["prep_s"]["count"] == 1
+    assert snap["gauges"]["queue_depth"] == 4
+    m.reset()
+    assert m.snapshot() == {"counters": {}, "timers": {}, "gauges": {}}
+
+
+def test_metrics_summary_derives_wire_rate():
+    m = MetricsRegistry()
+    m.add("wire_bytes", 50_000_000)
+    m.observe("wire_s", 2.0)
+    s = m.summary()
+    assert s["wire_MBps"] == 25.0
+    assert s["wire_bytes"] == 50_000_000
+    assert s["wire_s"] == 2.0
+
+
+def test_metrics_summary_json_serializable():
+    m = MetricsRegistry()
+    m.add("wire_bytes", 10)
+    m.observe("chunk_s", 0.25)
+    m.set_gauge("queue_depth", 0)
+    json.dumps(m.summary())
+
+
+# ---------------------------------------------------------------- journal
+
+def test_journal_roundtrip(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("abc", 3)
+    peaks = [_peak(snr=9.0), _peak(period=1.0, snr=8.0, dm=10.0)]
+    j.record_chunk(0, ["/x/a.inf"], [0.0], peaks,
+                   wire_digest="d0", timings={"chunk_s": 0.5}, attempts=2)
+    j.record_chunk(2, ["/x/c.inf"], [20.0], [], wire_digest="d2")
+    j.record_metrics({"chunks_done": 2})
+
+    j2 = SurveyJournal(tmp_path / "j")
+    assert j2.survey_id() == "abc"
+    done = j2.completed_chunks()
+    assert sorted(done) == [0, 2]
+    rec, got = done[0]
+    assert rec["files"] == ["a.inf"]
+    assert rec["attempts"] == 2
+    assert got == peaks  # exact float round-trip through JSON
+    assert done[2][1] == []
+    assert j2.last_metrics() == {"chunks_done": 2}
+
+
+def test_journal_header_mismatch_refuses_resume(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("abc", 3)
+    j.write_header("abc", 3)  # idempotent
+    with pytest.raises(JournalMismatch):
+        SurveyJournal(tmp_path / "j").write_header("OTHER", 3)
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("abc", 2)
+    j.record_chunk(0, ["a.inf"], [0.0], [_peak()])
+    # Simulate a kill mid-append: a torn, newline-less record fragment.
+    with open(j.journal_path, "ab") as f:
+        f.write(b'{"kind": "chunk", "chunk_id": 1, "pea')
+    done = SurveyJournal(tmp_path / "j").completed_chunks()
+    assert sorted(done) == [0]
+
+
+def test_journal_reconciles_missing_peak_rows(tmp_path):
+    """A chunk record whose peak rows never hit the store (kill between
+    the two appends) must be re-dispatched, not trusted."""
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("abc", 2)
+    j.record_chunk(0, ["a.inf"], [0.0], [_peak(), _peak(snr=8.0)])
+    # Truncate the peak store to one row: chunk 0's claim of rows [0, 2)
+    # no longer reconciles.
+    with open(j.peaks_path) as f:
+        first = f.readline()
+    with open(j.peaks_path, "w") as f:
+        f.write(first)
+    done = SurveyJournal(tmp_path / "j").completed_chunks()
+    assert done == {}
+
+
+def test_journal_retried_chunk_last_record_wins(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("abc", 1)
+    j.record_chunk(0, ["a.inf"], [0.0], [_peak(snr=7.0)])
+    j.record_chunk(0, ["a.inf"], [0.0], [_peak(snr=9.0)])
+    done = SurveyJournal(tmp_path / "j").completed_chunks()
+    assert done[0][1][0].snr == 9.0
+
+
+def test_survey_identity_sensitivity():
+    a = survey_identity(["x/a.inf", "x/b.inf"], {"k": 1})
+    assert a == survey_identity(["y/a.inf", "y/b.inf"], {"k": 1})  # basenames
+    assert a != survey_identity(["x/b.inf", "x/a.inf"], {"k": 1})  # order
+    assert a != survey_identity(["x/a.inf", "x/b.inf"], {"k": 2})  # config
+
+
+# ------------------------------------------------------------ fault plans
+
+def test_fault_plan_parse_and_consume():
+    sleeps = []
+    plan = FaultPlan.parse("raise:2x2,stall:1:0.25,corrupt:0",
+                           sleep=sleeps.append)
+    plan.before_dispatch(0)          # no directive for chunk 0 dispatch
+    plan.before_dispatch(1)          # stalls
+    assert sleeps == [0.25]
+    plan.before_dispatch(1)          # consumed: no further stall
+    assert sleeps == [0.25]
+    with pytest.raises(InjectedFault):
+        plan.before_dispatch(2)
+    with pytest.raises(InjectedFault):
+        plan.before_dispatch(2)      # x2: raises twice
+    plan.before_dispatch(2)          # then clean
+
+
+def test_fault_plan_abort():
+    plan = FaultPlan.parse("abort:3")
+    with pytest.raises(FaultAbort):
+        plan.before_dispatch(3)
+
+
+def test_fault_plan_rejects_bad_spec():
+    with pytest.raises(ValueError):
+        FaultPlan.parse("explode:1")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("raise")
+
+
+def test_fault_plan_corrupts_prepared_wire():
+    flat = np.zeros((1, 16), np.float32)
+    items = [(None, None, None, None, (flat, {"scales": None}))]
+    plan = FaultPlan.parse("corrupt:0")
+    assert plan.corrupt_wire(0, items)
+    assert flat.view("uint8").reshape(-1)[0] == 0xFF
+    assert not plan.corrupt_wire(0, items)  # consumed
+
+
+# ------------------------------------------------------------ retry policy
+
+def test_retry_policy_backoff_shape():
+    rp = RetryPolicy(max_retries=5, base_s=0.1, cap_s=0.4, jitter=0.0)
+    assert [rp.delay(k) for k in range(4)] == [0.1, 0.2, 0.4, 0.4]
+    jittered = RetryPolicy(base_s=1.0, cap_s=8.0, jitter=0.5)
+    for k in range(3):
+        d = jittered.delay(k)
+        base = min(8.0, 1.0 * 2 ** k)
+        assert 0.5 * base <= d <= 1.5 * base
+
+
+def test_retry_policy_sleeps():
+    slept = []
+    rp = RetryPolicy(base_s=0.5, jitter=0.0, sleep=slept.append)
+    rp.backoff(0)
+    rp.backoff(1)
+    assert slept == [0.5, 1.0]
+
+
+# ------------------------------------------------------- scheduler (unit)
+
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+
+def _searcher(io_threads=1):
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+
+    return BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                         SEARCH_CONF, fmt="presto", io_threads=io_threads)
+
+
+def _two_trials(tmp_path):
+    f1 = generate_data_presto(str(tmp_path), "a_DM0.00", tobs=TOBS,
+                              tsamp=TSAMP, period=PERIOD, dm=0.0)
+    f2 = generate_data_presto(str(tmp_path), "b_DM5.00", tobs=TOBS,
+                              tsamp=TSAMP, period=PERIOD, dm=5.0)
+    return f1, f2
+
+
+def _fast_retry():
+    return RetryPolicy(max_retries=3, base_s=0.01, cap_s=0.02,
+                       sleep=lambda s: None)
+
+
+def test_scheduler_transient_fault_retries(tmp_path):
+    get_metrics().reset()
+    f1, f2 = _two_trials(tmp_path)
+    journal = SurveyJournal(tmp_path / "j")
+    sched = SurveyScheduler(
+        _searcher(), [[f1], [f2]], journal=journal,
+        retry=_fast_retry(), faults=FaultPlan.parse("raise:1"),
+    )
+    peaks = sched.run()
+    assert peaks
+    assert get_metrics().counter("chunks_retried") >= 1
+    done = journal.completed_chunks()
+    assert sorted(done) == [0, 1]
+    assert done[1][0]["attempts"] == 2
+    # The metrics snapshot lands in the journal with the retry recorded.
+    assert journal.last_metrics()["chunks_retried"] >= 1
+
+
+def test_scheduler_corrupted_wire_repreps_and_retries(tmp_path):
+    get_metrics().reset()
+    f1, f2 = _two_trials(tmp_path)
+    journal = SurveyJournal(tmp_path / "j")
+    sched = SurveyScheduler(
+        _searcher(), [[f1], [f2]], journal=journal,
+        retry=_fast_retry(), faults=FaultPlan.parse("corrupt:0"),
+    )
+    peaks = sched.run()
+    best = max(peaks, key=lambda p: p.snr)
+    assert abs(best.period - PERIOD) < 1e-3
+    done = journal.completed_chunks()
+    assert done[0][0]["attempts"] == 2  # digest mismatch forced a re-prep
+    assert done[0][0]["wire_digest"]
+    assert get_metrics().counter("chunks_retried") >= 1
+
+
+def test_scheduler_exhausted_retries_raise(tmp_path):
+    get_metrics().reset()
+    f1, _ = _two_trials(tmp_path)
+    sched = SurveyScheduler(
+        _searcher(), [[f1]],
+        retry=RetryPolicy(max_retries=1, sleep=lambda s: None),
+        faults=FaultPlan.parse("raise:0x5"),
+    )
+    with pytest.raises(InjectedFault):
+        sched.run()
+
+
+def test_scheduler_resume_skips_and_matches(tmp_path):
+    """Kill (abort fault) mid-queue, resume, and get the identical peak
+    list an uninterrupted scheduler produces — with the completed chunk
+    replayed, not re-searched."""
+    f1, f2 = _two_trials(tmp_path)
+
+    get_metrics().reset()
+    uninterrupted = SurveyScheduler(_searcher(), [[f1], [f2]]).run()
+
+    jdir = tmp_path / "j"
+    with pytest.raises(FaultAbort):
+        SurveyScheduler(
+            _searcher(), [[f1], [f2]], journal=SurveyJournal(jdir),
+            faults=FaultPlan.parse("abort:1"),
+        ).run()
+    assert sorted(SurveyJournal(jdir).completed_chunks()) == [0]
+
+    get_metrics().reset()
+    resumed = SurveyScheduler(
+        _searcher(), [[f1], [f2]], journal=SurveyJournal(jdir), resume=True,
+    ).run()
+    assert get_metrics().counter("chunks_skipped") == 1
+    assert resumed == uninterrupted
+
+
+# ------------------------------------------------- pipeline (end to end)
+
+def _survey_config(processes=1):
+    return {
+        "processes": processes,
+        "data": {"format": "presto", "fmin": None, "fmax": None,
+                 "nchans": None},
+        "dmselect": {"min": 0.0, "max": 30.0, "dmsinb_max": None},
+        "dereddening": {"rmed_width": 4.0, "rmed_minpts": 101},
+        "ranges": [{
+            "name": "test",
+            "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                           "bins_min": 64, "bins_max": 71,
+                           "fpmin": 8, "wtsp": 1.5, "ducy_max": 0.30},
+            "find_peaks": {"smin": 6.0},
+            "candidates": {"bins": 64, "subints": 8},
+        }],
+        "clustering": {"radius": 0.2},
+        "harmonic_flagging": {"denom_max": 100, "phase_distance_max": 1.0,
+                              "dm_distance_max": 3.0,
+                              "snr_distance_max": 3.0},
+        "candidate_filters": {"dm_min": None, "snr_min": 7.0,
+                              "remove_harmonics": True, "max_number": None},
+        "plot_candidates": False,
+    }
+
+
+def _make_survey(outdir):
+    files = []
+    for dm, amp in AMPLITUDES.items():
+        files.append(generate_data_presto(
+            str(outdir), f"fake_DM{dm:.2f}", tobs=TOBS, tsamp=TSAMP,
+            period=PERIOD, dm=dm, amplitude=amp, ducy=0.02,
+        ))
+    return files
+
+
+def _run_pipeline(files, outdir, **kwargs):
+    from riptide_tpu.pipeline import Pipeline
+
+    pipeline = Pipeline(_survey_config(), **kwargs)
+    pipeline.process([str(f) for f in files], str(outdir))
+    return pipeline
+
+
+def test_pipeline_kill_and_resume_byte_identical(tmp_path):
+    """The acceptance path: a survey killed mid-queue (injected abort on
+    the last of three single-file chunks) resumes from the journal,
+    skips the completed chunks, and produces byte-identical peaks.csv
+    and candidates.csv to an uninterrupted run."""
+    indir = tmp_path / "data"
+    indir.mkdir()
+    files = _make_survey(indir)
+
+    out_a = tmp_path / "out_a"
+    out_a.mkdir()
+    get_metrics().reset()
+    _run_pipeline(files, out_a)  # uninterrupted, no journal
+
+    out_b = tmp_path / "out_b"
+    out_b.mkdir()
+    jdir = str(tmp_path / "journal")
+    get_metrics().reset()
+    with pytest.raises(FaultAbort):
+        _run_pipeline(files, out_b, journal=jdir, fault_spec="abort:2")
+    # The kill left chunks 0 and 1 journaled, chunk 2 pending, and no
+    # data products written.
+    assert sorted(SurveyJournal(jdir).completed_chunks()) == [0, 1]
+    assert not (out_b / "peaks.csv").exists()
+
+    get_metrics().reset()
+    _run_pipeline(files, out_b, journal=jdir, resume=True, fault_spec="")
+    assert get_metrics().counter("chunks_skipped") == 2
+    assert get_metrics().counter("chunks_done") == 1
+
+    for product in ("peaks.csv", "candidates.csv"):
+        a = (out_a / product).read_bytes()
+        b = (out_b / product).read_bytes()
+        assert a == b, f"{product} differs between uninterrupted and resumed"
+
+
+def test_pipeline_fault_injection_retry_completes(tmp_path):
+    """Acceptance: an injected transient device error on chunk 1 is
+    retried with backoff; the survey completes and the journal's metrics
+    snapshot records chunks_retried >= 1."""
+    indir = tmp_path / "data"
+    indir.mkdir()
+    files = _make_survey(indir)
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    jdir = str(tmp_path / "journal")
+
+    get_metrics().reset()
+    _run_pipeline(files, outdir, journal=jdir, fault_spec="raise:1")
+    assert (outdir / "peaks.csv").exists()
+    snap = SurveyJournal(jdir).last_metrics()
+    assert snap["chunks_retried"] >= 1
+    assert snap["chunks_done"] == 3
+
+
+def test_pipeline_resume_requires_journal():
+    from riptide_tpu.pipeline import Pipeline
+
+    with pytest.raises(ValueError):
+        Pipeline(_survey_config(), resume=True)
+
+
+def test_rffa_parser_has_survey_flags():
+    from riptide_tpu.pipeline import get_parser
+
+    args = get_parser().parse_args(
+        ["-c", "conf.yaml", "--journal", "jdir", "--resume",
+         "--fault-inject", "raise:2", "x.inf"]
+    )
+    assert args.journal == "jdir"
+    assert args.resume is True
+    assert args.fault_inject == "raise:2"
+
+
+# ------------------------------------------------------------ rseek CLI
+
+def _rseek_args(fname, extra=()):
+    from riptide_tpu.apps.rseek import get_parser
+
+    return get_parser().parse_args(
+        ["-f", "presto", "--Pmin", "0.4", "--Pmax", "1.2",
+         "--bmin", "64", "--bmax", "71", *extra, str(fname)]
+    )
+
+
+def test_rseek_journal_and_resume(tmp_path, monkeypatch):
+    from riptide_tpu.apps import rseek
+
+    inf = generate_data_presto(str(tmp_path), "fake_DM0.00", tobs=TOBS,
+                               tsamp=TSAMP, period=PERIOD, dm=0.0,
+                               amplitude=20.0, ducy=0.02)
+    jdir = str(tmp_path / "journal")
+    df1 = rseek.run_program(_rseek_args(inf, ["--journal", jdir]))
+    assert df1 is not None
+    assert sorted(SurveyJournal(jdir).completed_chunks()) == [0]
+
+    # Resume must replay from the journal without searching.
+    def _no_search(*a, **kw):
+        raise AssertionError("resume must not re-search")
+
+    monkeypatch.setattr(rseek, "_search_peaks", _no_search)
+    df2 = rseek.run_program(_rseek_args(inf, ["--journal", jdir,
+                                              "--resume"]))
+    assert df2 is not None
+    assert df1.equals(df2)
+
+
+def test_rseek_resume_requires_journal(tmp_path):
+    from riptide_tpu.apps import rseek
+
+    inf = generate_data_presto(str(tmp_path), "fake_DM0.00", tobs=TOBS,
+                               tsamp=TSAMP, period=PERIOD, dm=0.0,
+                               amplitude=20.0, ducy=0.02)
+    with pytest.raises(ValueError):
+        rseek.run_program(_rseek_args(inf, ["--resume"]))
+
+
+def test_rseek_fault_injection_retries(tmp_path):
+    from riptide_tpu.apps import rseek
+
+    inf = generate_data_presto(str(tmp_path), "fake_DM0.00", tobs=TOBS,
+                               tsamp=TSAMP, period=PERIOD, dm=0.0,
+                               amplitude=20.0, ducy=0.02)
+    get_metrics().reset()
+    df = rseek.run_program(_rseek_args(inf, ["--fault-inject", "raise:0"]))
+    assert df is not None
+    assert get_metrics().counter("chunks_retried") >= 1
+
+
+# ------------------------------------------------------------- multihost
+
+def test_multihost_journals_on_process_zero(tmp_path):
+    """Single-process run: process_index() == 0, so the search result
+    and a metrics snapshot land in the journal."""
+    from riptide_tpu.libffa import generate_signal
+    from riptide_tpu.parallel import run_search_multihost
+    from riptide_tpu.search import periodogram_plan
+
+    N, tsamp = 4096, 1e-3
+    plan = periodogram_plan(N, tsamp, (1, 2, 3), 64e-3, 0.15, 64, 71)
+    np.random.seed(0)
+    batch = np.stack([
+        generate_signal(N, 64.0, amplitude=15.0, ducy=0.05),
+        np.random.standard_normal(N).astype(np.float32),
+    ])
+    batch -= batch.mean(axis=1, keepdims=True)
+    batch /= batch.std(axis=1, keepdims=True)
+
+    get_metrics().reset()
+    journal = SurveyJournal(tmp_path / "j")
+    journal.write_header("mh", 1)
+    peaks, _ = run_search_multihost(plan, batch, tobs=N * tsamp,
+                                    dms_local=[2.0, 3.0], journal=journal)
+    assert peaks
+    done = journal.completed_chunks()
+    assert 0 in done
+    assert done[0][1] == peaks
+    assert journal.last_metrics() is not None
+
+
+# -------------------------------------------------- engine metrics hooks
+
+def test_engine_records_prep_wire_device_metrics(tmp_path):
+    """One batched search through the engine must populate the survey
+    metrics the bench emits (prep_s, wire_s/wire_bytes, device_s)."""
+    from riptide_tpu.libffa import generate_signal
+    from riptide_tpu.search import periodogram_plan
+    from riptide_tpu.search.engine import run_search_batch
+
+    N, tsamp = 4096, 1e-3
+    plan = periodogram_plan(N, tsamp, (1, 2, 3), 64e-3, 0.15, 64, 71)
+    np.random.seed(0)
+    batch = generate_signal(N, 64.0, amplitude=15.0, ducy=0.05)[None]
+    batch = (batch - batch.mean()) / batch.std()
+
+    get_metrics().reset()
+    run_search_batch(plan, batch, tobs=N * tsamp)
+    s = get_metrics().summary()
+    assert s["wire_bytes"] > 0
+    assert "prep_s" in s and "wire_s" in s and "device_s" in s

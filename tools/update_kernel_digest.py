@@ -1,0 +1,41 @@
+"""
+Re-pin riptide_tpu/ops/kernel_digest.json for the running Python.
+
+Run this AFTER bumping KERNEL_CACHE_VERSION (or when adding a new
+Python version to CI). tests/test_kernel_cache_version.py fails when
+the kernel/table-builder bytecode changes while the pinned version
+stays the same — the reminder that stale cached kernel executables
+compute wrong numbers, not crashes.
+
+Usage: python tools/update_kernel_digest.py
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from riptide_tpu.ops.ffa_kernel import (  # noqa: E402
+    KERNEL_CACHE_VERSION, kernel_code_digest,
+)
+
+PATH = os.path.join(os.path.dirname(__file__), "..", "riptide_tpu", "ops",
+                    "kernel_digest.json")
+
+
+def main():
+    with open(PATH) as f:
+        data = json.load(f)
+    py = f"{sys.version_info[0]}.{sys.version_info[1]}"
+    entry = {"kernel_cache_version": KERNEL_CACHE_VERSION,
+             "digest": kernel_code_digest()}
+    old = data["digests"].get(py)
+    data["digests"][py] = entry
+    with open(PATH, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    print(f"python {py}: {old} -> {entry}")
+
+
+if __name__ == "__main__":
+    main()
