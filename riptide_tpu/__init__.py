@@ -31,6 +31,13 @@ from .search import ffa_search, periodogram_plan, run_periodogram, run_periodogr
 from .serialization import save_json, load_json
 from .peak_detection import find_peaks, Peak
 from .candidate import Candidate
+from .quality import (
+    DegradedInputWarning,
+    DQConfig,
+    MalformedFile,
+    QualityReport,
+    QuarantinedSeries,
+)
 
 __version__ = "0.5.0"
 

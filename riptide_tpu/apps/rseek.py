@@ -142,6 +142,9 @@ def _search_with_survey_hooks(args, ts):
 
     faults = FaultPlan.parse(args.fault_inject
                              or os.environ.get("RIPTIDE_FAULT_INJECT"))
+    # nan_inject directives corrupt the loaded samples BEFORE the
+    # data-quality scan inside ffa_search, exercising the masking path.
+    faults.nan_inject(0, ts.data)
     metrics = get_metrics()
     t0 = time.perf_counter()
     peaks, attempts = run_with_retry(
@@ -182,7 +185,16 @@ def run_program(args):
         f"Searching period range [{args.Pmin}, {args.Pmax}] seconds "
         f"with {args.bmin} to {args.bmax} phase bins"
     )
-    peaks = _search_with_survey_hooks(args, ts)
+    from riptide_tpu.quality import QuarantinedSeries
+
+    try:
+        peaks = _search_with_survey_hooks(args, ts)
+    except QuarantinedSeries as err:
+        # Degraded beyond searchability: report, don't crash.
+        log.error("input quarantined by the data-quality scan: %s",
+                  err.report.to_dict())
+        print(f"Input quarantined: {err.report.describe()}")
+        return None
     if not peaks:
         print(f"No peaks found above S/N = {args.smin:.2f}")
         return None

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import quality
 from .reference import _boxcar_coeffs
 
 __all__ = ["boxcar_coeffs", "snr_batched", "boxcar_snr"]
@@ -69,7 +70,13 @@ def snr_batched(tbuf, p, widths, hcoef, bcoef, stdnoise):
     Returns (B, R, NW) float32. Rows >= rows_eval are garbage to be
     discarded by the caller (they are still computed; pruning happens by
     slicing on the host, which is cheaper than dynamic shapes on TPU).
+
+    Inputs are expected already DQ-clean: the finite guard below trips
+    only on concrete host arrays (tracers pass through), since one
+    non-finite profile value poisons every phase of its problem via the
+    cumulative sum.
     """
+    quality.check_finite_array(tbuf, where="ops.snr.snr_batched")
     B, R, P = tbuf.shape
     cs = jnp.cumsum(tbuf, axis=-1)
     total = cs[..., -1:]
@@ -95,14 +102,24 @@ def _boxcar_snr_2d(data, coeffs, widths):
     return jnp.stack(outs, axis=-1)
 
 
-def boxcar_snr(data, widths, stdnoise=1.0):
+def boxcar_snr(data, widths, stdnoise=1.0, eff_frac=1.0):
     """
     S/N of pulse profile(s) for a range of boxcar width trials; same
     contract as the reference's ``libffa.boxcar_snr``
     (riptide/libffa.py:194-225): input of any shape with phase as the last
     axis, output gains a trailing width-trial axis.
+
+    ``eff_frac`` is the effective-nsamp fraction of the folded series
+    (``nsamp_eff / nsamp``, i.e. ``1 - masked_frac`` from the
+    data-quality scan): the S/N is scaled by ``1 / eff_frac`` so folds
+    of partially-masked data stay on the clean S/N scale — the same
+    correction ``TimeSeries.normalise(mask=...)`` applies upstream on
+    the batched device path (do not apply both).
     """
     data = np.asarray(data, dtype=np.float32)
+    quality.check_finite_array(data, where="ops.snr.boxcar_snr")
+    if not 0.0 < eff_frac <= 1.0:
+        raise ValueError("eff_frac must be in (0, 1]")
     # Integer widths only, like the reference's uint64 cast
     # (riptide/libffa.py:219); truncating BEFORE computing coefficients
     # keeps window and coefficients consistent.
@@ -117,4 +134,6 @@ def boxcar_snr(data, widths, stdnoise=1.0):
     flat = data.reshape(-1, nbins)
     snr = _boxcar_snr_2d(jnp.asarray(flat), jnp.asarray(coeffs), tuple(int(w) for w in widths))
     snr = np.asarray(snr) / np.float32(stdnoise)
+    if eff_frac != 1.0:
+        snr = snr / np.float32(eff_frac)
     return snr.reshape(list(data.shape[:-1]) + [widths.size])

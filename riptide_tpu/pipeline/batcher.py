@@ -22,14 +22,19 @@ move file paths in and small Peak lists out of its workers
 (riptide/pipeline/worker_pool.py:47-71).
 """
 import logging
+import os
 from collections import defaultdict
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from .. import quality
 from ..ffautils import generate_width_trials
 from ..search import periodogram_plan
-from ..search.engine import collect_search_batch, queue_search_batch
+from ..search.engine import (
+    collect_search_batch, is_oom_error, queue_search_batch,
+    run_search_batch,
+)
 from ..survey.metrics import get_metrics
 from ..time_series import TimeSeries
 
@@ -52,6 +57,17 @@ class BatchSearcher:
     mesh : jax.sharding.Mesh or None
         When given, the DM batch is sharded over the mesh's 'dm' axis;
         otherwise the whole batch runs on the default device.
+    dq : dict or DQConfig or None
+        Data-quality configuration (riptide_tpu.quality.DQConfig):
+        every loaded series is scanned, repaired and mask-normalised;
+        series over max_masked_frac are quarantined (dropped from the
+        batch with a structured report); the ingest_policy governs
+        truncated/malformed files. None -> defaults.
+    faults : FaultPlan or None
+        Fault-injection hooks (nan_inject / oom kinds fire here).
+    oom_floor : int
+        Smallest DM sub-batch the OOM bisection will retry; a batch
+        that still exhausts device memory at this size propagates.
     """
 
     TIMESERIES_LOADERS = {
@@ -60,7 +76,8 @@ class BatchSearcher:
     }
 
     def __init__(self, deredden_params, range_confs, fmt="presto",
-                 io_threads=4, mesh=None, batch_size=None):
+                 io_threads=4, mesh=None, batch_size=None, dq=None,
+                 faults=None, oom_floor=1):
         self.deredden_params = deredden_params
         self.range_confs = range_confs
         self.loader = self.TIMESERIES_LOADERS[fmt]
@@ -70,18 +87,85 @@ class BatchSearcher:
         # ragged final chunk reuses the compiled D-specialised programs
         # instead of forcing a recompile (padded trials are discarded).
         self.batch_size = batch_size
+        self.dq = quality.DQConfig.from_any(dq)
+        self.faults = faults
+        self.oom_floor = max(1, int(oom_floor))
+        # basename -> QualityReport of every file this searcher loaded
+        # (quarantined ones included); read by the pipeline for the
+        # peaks.csv/candidates provenance columns and by the scheduler
+        # for the journal's per-chunk DQ summary. dict assignment is
+        # atomic under the GIL, so loader threads may write concurrently.
+        self.dq_reports = {}
 
     # -- host side ----------------------------------------------------------
 
-    def load_prepared(self, fname):
-        """Load one file, de-redden then normalise (once, shared by all
-        search ranges — riptide/pipeline/worker_pool.py:54-58)."""
-        ts = self.loader(fname)
-        ts = ts.deredden(
-            self.deredden_params["rmed_width"],
-            minpts=self.deredden_params["rmed_minpts"],
+    def load_prepared(self, fname, chunk_id=0, search=True):
+        """Load one file, then scan/repair/de-redden/mask-normalise it
+        (once, shared by all search ranges —
+        riptide/pipeline/worker_pool.py:54-58). Returns None when the
+        file was skipped by the ingest policy or the series was
+        quarantined by the data-quality scan.
+
+        ``search=False`` is the candidate-rebuild reload: no fault
+        injection, no DQ metrics, and the search-time QualityReport is
+        kept — the survey already counted this file once."""
+        ts = self.loader(fname, policy=self.dq.ingest_policy)
+        if ts is None:
+            return None
+        if search and self.faults is not None:
+            self.faults.nan_inject(chunk_id, ts.data)
+        prepared, report = quality.prepare_time_series(
+            ts,
+            rmed_width=self.deredden_params["rmed_width"],
+            rmed_minpts=self.deredden_params["rmed_minpts"],
+            dq=self.dq,
+            record=search,
         )
-        return ts.normalise()
+        if search:
+            self.dq_reports[os.path.basename(fname)] = report
+        return prepared
+
+    def dq_by_dm(self):
+        """{dm: masked_frac} provenance map over every loaded series.
+        A series without a DM in its metadata files under 0.0 — the
+        same fallback its Peak rows carry — and collisions keep the
+        largest masked fraction (the degraded series must not be
+        reported clean)."""
+        out = {}
+        for r in self.dq_reports.values():
+            key = float(r.dm) if r.dm is not None else 0.0
+            out[key] = max(out.get(key, 0.0), r.masked_frac)
+        return out
+
+    def chunk_dq_summary(self, fnames):
+        """JSON-able DQ summary of one chunk's files (for the survey
+        journal's chunk records). The per-file reports ride along so a
+        resumed survey can restore them (``restore_dq_reports``) and
+        reproduce the provenance columns byte-identically."""
+        reports = [self.dq_reports.get(os.path.basename(f)) for f in fnames]
+        reports = [r for r in reports if r is not None]
+        if not reports:
+            return {}
+        out = {
+            "masked_samples": int(sum(r.n_masked for r in reports)),
+            "masked_frac_max": round(max(r.masked_frac for r in reports), 6),
+            "files": [r.to_dict() for r in reports],
+        }
+        quarantined = [r.fname for r in reports if r.quarantined]
+        if quarantined:
+            out["quarantined"] = quarantined
+        return out
+
+    def restore_dq_reports(self, dq_record):
+        """Re-register per-file QualityReports from a journal chunk
+        record's ``dq`` block (resume path: replayed chunks never
+        re-load their files, so their provenance must come from the
+        journal)."""
+        for d in (dq_record or {}).get("files", []):
+            if d.get("fname"):
+                self.dq_reports.setdefault(
+                    d["fname"], quality.QualityReport.from_dict(d)
+                )
 
     # -- chunk processing ---------------------------------------------------
 
@@ -107,18 +191,21 @@ class BatchSearcher:
                 ThreadPoolExecutor(max_workers=1) as shipper, \
                 ThreadPoolExecutor(max_workers=self.io_threads) as loaders:
 
-            def stage_chunk(fnames):
-                tslist = list(loaders.map(self.load_prepared, fnames))
+            def stage_chunk(fnames, cid):
+                tslist = list(loaders.map(
+                    lambda f: self.load_prepared(f, chunk_id=cid), fnames
+                ))
                 items = self._prepare_chunk(tslist)
                 return shipper.submit(self._ship_chunk, items)
 
-            pending = stager.submit(stage_chunk, chunks[0]) if chunks else None
+            pending = (stager.submit(stage_chunk, chunks[0], 0)
+                       if chunks else None)
             queued = None
             for i, chunk in enumerate(chunks):
                 metrics.set_gauge("queue_depth", len(chunks) - i)
                 ship_fut = pending.result()   # prep done, ship submitted
                 if i + 1 < len(chunks):
-                    pending = stager.submit(stage_chunk, chunks[i + 1])
+                    pending = stager.submit(stage_chunk, chunks[i + 1], i + 1)
                 items = ship_fut.result()     # wire transfer enqueued
                 # Queue chunk i's device work BEFORE collecting chunk
                 # i-1: the device stays busy while the host pays the
@@ -159,9 +246,13 @@ class BatchSearcher:
         """Host half of one chunk: group by shape, build the (D, N)
         batches, and — on the unsharded path — run the wire preparation
         (downsampling) so only device work remains. Returns a list of
-        (members, batch, conf, plan, prepared) work items."""
+        (members, batch, conf, plan, prepared) work items. Entries of
+        ``tslist`` that are None (files skipped by the ingest policy or
+        series quarantined by the DQ scan) are dropped here, so both
+        the stream and scheduler paths tolerate degraded chunks."""
         from ..search.engine import prepare_stage_data
 
+        tslist = [ts for ts in tslist if ts is not None]
         # Batch programs need equal-shape inputs: group by (nsamp, tsamp).
         # In practice all DM trials of one observation are identical.
         groups = defaultdict(list)
@@ -243,14 +334,91 @@ class BatchSearcher:
                 return [p for d in range(nreal) for p in peaks_per_trial[d]]
 
             return collect_mesh
-        handle = queue_search_batch(
-            plan, batch, tobs=tobs, shipped=shipped, **fp_kwargs
-        )
+        try:
+            self._maybe_oom(batch.shape[0])
+            handle = queue_search_batch(
+                plan, batch, tobs=tobs, shipped=shipped, **fp_kwargs
+            )
+        except Exception as err:
+            if not is_oom_error(err):
+                raise
+            # Queue-time OOM: fall back to a bisecting collector.
+            # (`except` unbinds its name when the block exits, so the
+            # closure must capture a separate binding.)
+            oom_err = err
+            return lambda: self._collect_bisected(
+                plan, batch, dms, tobs, fp_kwargs, nreal, oom_err
+            )
 
         def collect():
-            peaks_per_trial, _ = collect_search_batch(handle, dms)
+            try:
+                peaks_per_trial, _ = collect_search_batch(handle, dms)
+            except Exception as err:
+                if not is_oom_error(err):
+                    raise
+                return self._collect_bisected(
+                    plan, batch, dms, tobs, fp_kwargs, nreal, err
+                )
             # Padded trials (zero data) produce no peaks; slice to real
             # ones.
             return [p for d in range(nreal) for p in peaks_per_trial[d]]
 
         return collect
+
+    # -- OOM-aware adaptive bisection ---------------------------------------
+
+    def _maybe_oom(self, batch_size):
+        """Fault-injection hook: a configured ``oom`` directive raises a
+        simulated RESOURCE_EXHAUSTED here, upstream of the real device
+        dispatch, so the bisection path is exercisable on CPU."""
+        if self.faults is not None:
+            self.faults.maybe_oom(batch_size)
+
+    def _collect_bisected(self, plan, batch, dms, tobs, fp_kwargs, nreal,
+                          err):
+        """Recovery path after device memory exhaustion on a full
+        (search range x chunk) batch: split the DM batch in half and
+        search the halves synchronously, recursing down to
+        ``oom_floor`` trials. Each downshift is recorded as an
+        ``oom_bisections`` metric. The halves re-prepare their own wire
+        buffers; per-trial quantisation makes the sub-batch S/N (hence
+        the peaks) identical to an unthrottled run's."""
+        D = batch.shape[0]
+        if D <= self.oom_floor:
+            raise err
+        get_metrics().add("oom_bisections")
+        log.warning(
+            "device OOM on a %d-trial batch (%s); bisecting into %d + %d",
+            D, err, (D + 1) // 2, D - (D + 1) // 2,
+        )
+        dms = np.asarray(dms, dtype=float)
+        mid = (D + 1) // 2
+        ppt = (
+            self._search_slice(plan, batch, dms, tobs, fp_kwargs, 0, mid)
+            + self._search_slice(plan, batch, dms, tobs, fp_kwargs, mid, D)
+        )
+        return [p for d in range(nreal) for p in ppt[d]]
+
+    def _search_slice(self, plan, batch, dms, tobs, fp_kwargs, lo, hi):
+        """Search DM trials [lo, hi) as one device batch, bisecting
+        recursively on further OOM; returns per-trial peak lists."""
+        D = hi - lo
+        try:
+            self._maybe_oom(D)
+            ppt, _ = run_search_batch(
+                plan, batch[lo:hi], tobs=tobs, dms=dms[lo:hi], **fp_kwargs
+            )
+            return list(ppt)
+        except Exception as err:
+            if not is_oom_error(err) or D <= self.oom_floor:
+                raise
+            get_metrics().add("oom_bisections")
+            mid = lo + (D + 1) // 2
+            log.warning(
+                "device OOM on a %d-trial sub-batch (%s); bisecting into "
+                "%d + %d", D, err, mid - lo, hi - mid,
+            )
+            return (
+                self._search_slice(plan, batch, dms, tobs, fp_kwargs, lo, mid)
+                + self._search_slice(plan, batch, dms, tobs, fp_kwargs, mid, hi)
+            )

@@ -62,6 +62,15 @@ class Field:
         return coerced
 
 
+class Section:
+    """An optional nested mapping with its own spec: absent -> omitted
+    entirely (downstream code applies its own defaults), present ->
+    validated like any required mapping."""
+
+    def __init__(self, spec):
+        self.spec = spec
+
+
 def _strict_int(x):
     # bool is an int subclass; YAML ints must stay ints
     if isinstance(x, bool) or not isinstance(x, int):
@@ -91,12 +100,15 @@ def _validate_mapping(spec, conf, path=""):
             # Optional keys are omitted entirely so downstream **kwargs
             # expansion picks up the function defaults (the reference's
             # schema.Optional has the same effect).
-            if isinstance(sub, Field) and sub.optional:
+            if isinstance(sub, (Field, Section)) and \
+                    getattr(sub, "optional", True):
                 continue
             raise InvalidPipelineConfig(f"{kpath}: missing required key")
         val = conf[key]
         if isinstance(sub, Field):
             out[key] = sub.validate(val, kpath)
+        elif isinstance(sub, Section):
+            out[key] = _validate_mapping(sub.spec, val, kpath)
         elif isinstance(sub, dict):
             out[key] = _validate_mapping(sub, val, kpath)
         elif isinstance(sub, list):
@@ -174,6 +186,32 @@ PIPELINE_CONFIG_SPEC = {
         "rmed_width": Field(_number, _pos, "rmed_width must be a number > 0"),
         "rmed_minpts": Field(_number, _pos, "rmed_minpts must be a number > 0"),
     },
+    # Optional degraded-input handling (riptide_tpu.quality); omitted
+    # keys fall back to the DQConfig / BatchSearcher defaults.
+    "data_quality": Section({
+        "enabled": Field(_strict_bool, error="enabled must be a boolean",
+                         optional=True),
+        "max_masked_frac": Field(
+            _number, lambda x: 0 <= x <= 1,
+            "max_masked_frac must be a number in [0, 1]", optional=True,
+        ),
+        "ingest_policy": Field(
+            str, lambda x: x in ("strict", "salvage", "skip"),
+            "ingest_policy must be 'strict', 'salvage' or 'skip'",
+            optional=True,
+        ),
+        "clip_run_min": Field(_strict_int, _pos,
+                              "clip_run_min must be an int > 0", optional=True),
+        "dead_run_min": Field(_strict_int, _pos,
+                              "dead_run_min must be an int > 0", optional=True),
+        "dc_block": Field(_strict_int, _pos,
+                          "dc_block must be an int > 0", optional=True),
+        "dc_nstd": Field(_number, _pos,
+                         "dc_nstd must be a number > 0 or null/blank",
+                         optional=True, nullable=True),
+        "oom_floor": Field(_strict_int, _pos,
+                           "oom_floor must be an int > 0", optional=True),
+    }),
     "ranges": [SEARCH_RANGE_SPEC],
     "clustering": {
         "radius": Field(_number, _pos, "clustering radius must be a number > 0"),

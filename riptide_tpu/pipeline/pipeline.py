@@ -29,6 +29,7 @@ from .. import __version__
 from ..candidate import Candidate
 from ..clustering import cluster1d
 from ..serialization import save_json
+from ..survey.faults import FaultPlan
 from ..timing import maybe_trace, timing
 from .batcher import BatchSearcher
 from .config_validation import validate_pipeline_config, validate_ranges
@@ -129,6 +130,11 @@ class Pipeline:
         self.resume = bool(resume)
         self.fault_spec = (fault_spec if fault_spec is not None
                            else os.environ.get("RIPTIDE_FAULT_INJECT"))
+        # ONE fault plan shared by the scheduler (raise/stall/abort/
+        # corrupt kinds) and the batch searcher (nan_inject/oom kinds),
+        # so directive budgets are consumed consistently. Parsing here
+        # also fails fast on a bad spec.
+        self.faults = FaultPlan.parse(self.fault_spec)
         if self.resume and not self.journal_dir:
             raise ValueError("resume=True requires a journal directory")
         self.dmiter = None
@@ -201,6 +207,8 @@ class Pipeline:
         log.info(f"Max sampling time = {tsamp_max:.6e} s; validating ranges")
         validate_ranges(conf["ranges"], tsamp_max)
 
+        dq_conf = dict(conf.get("data_quality") or {})
+        oom_floor = dq_conf.pop("oom_floor", 1)
         self.searcher = BatchSearcher(
             conf["dereddening"],
             conf["ranges"],
@@ -208,6 +216,9 @@ class Pipeline:
             io_threads=conf["processes"],
             mesh=self.mesh,
             batch_size=conf["processes"],
+            dq=dq_conf,
+            faults=self.faults,
+            oom_floor=oom_floor,
         )
         log.info("Pipeline ready")
 
@@ -234,7 +245,6 @@ class Pipeline:
 
     def _search_journaled(self, chunks):
         """Checkpointed search through the survey scheduler."""
-        from ..survey.faults import FaultPlan
         from ..survey.journal import SurveyJournal
         from ..survey.scheduler import SurveyScheduler, survey_identity
 
@@ -247,7 +257,7 @@ class Pipeline:
             self.searcher, chunks,
             journal=SurveyJournal(self.journal_dir),
             resume=self.resume,
-            faults=FaultPlan.parse(self.fault_spec),
+            faults=self.faults,
             survey_id=survey_id,
         )
         return scheduler.run()
@@ -353,8 +363,22 @@ class Pipeline:
             grouped[cl.centre.dm].append(cl)
         log.debug(f"{len(by_snr)} candidates to build from {len(grouped)} TimeSeries")
 
+        dq_by_dm = self.searcher.dq_by_dm()
         for dm, clusters in grouped.items():
-            ts = self.searcher.load_prepared(self.dmiter.get_filename(dm))
+            # search=False: a rebuild reload must not re-fire fault
+            # directives or double-count the DQ metrics the search
+            # already recorded for this file.
+            ts = self.searcher.load_prepared(self.dmiter.get_filename(dm),
+                                             search=False)
+            if ts is None:
+                # Only possible if the file degraded between the search
+                # and the re-load (a searched DM cannot have been
+                # quarantined); report rather than crash the run.
+                log.error(
+                    "DM %.3f trial was skipped/quarantined on re-load; "
+                    "dropping its %d candidate cluster(s)", dm, len(clusters),
+                )
+                continue
             for cl in clusters:
                 try:
                     rng = self.get_search_range(cl.centre.period)
@@ -362,6 +386,11 @@ class Pipeline:
                         ts, cl,
                         rng["candidates"]["bins"],
                         subints=rng["candidates"]["subints"],
+                    )
+                    # Data provenance for downstream vetting: fraction
+                    # of this trial's samples masked by the DQ scan.
+                    cand.params["masked_frac"] = round(
+                        dq_by_dm.get(cl.centre.dm, 0.0), 6
                     )
                     self.candidates.append(cand)
                 except Exception as err:
@@ -385,6 +414,13 @@ class Pipeline:
         df_peaks = pandas.DataFrame.from_dict(
             [p.summary_dict() for p in self.peaks]
         )
+        # Data provenance column: the masked fraction of the DM trial
+        # each peak came from, so downstream vetting can weigh peaks
+        # from degraded data accordingly.
+        dq_by_dm = self.searcher.dq_by_dm() if self.searcher else {}
+        df_peaks["masked_frac"] = [
+            round(dq_by_dm.get(p.dm, 0.0), 6) for p in self.peaks
+        ]
         fname = os.path.join(outdir, "peaks.csv")
         df_peaks.to_csv(fname, sep=",", index=False, float_format="%.9f")
         log.info(f"Saved Peak data to {fname!r}")

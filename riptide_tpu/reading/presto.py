@@ -33,9 +33,14 @@ def _int_pair(s):
 
 
 def parse_inf(text):
-    """Parse .inf text to a dict; raises ValueError on makedata files and
-    unknown EM bands (riptide/reading/presto.py:57-121)."""
+    """Parse .inf text to a dict; raises ValueError on makedata files,
+    unknown EM bands and truncated headers
+    (riptide/reading/presto.py:57-121)."""
     lines = text.strip("\n").splitlines()
+    if len(lines) < 13:
+        raise ValueError(
+            f"truncated .inf header: {len(lines)} lines (at least 13 expected)"
+        )
 
     basename = _value(lines[0], str)
     telescope = _value(lines[1], str)
@@ -67,23 +72,30 @@ def parse_inf(text):
                 break
     lines = lines[len(items["onoff_pairs"]) :]
 
+    if not lines:
+        raise ValueError("truncated .inf header: EM-band block missing")
     em_band = _value(lines[0], str)
     items["em_band"] = em_band
-    if em_band == "Radio":
-        items["fov_arcsec"] = _value(lines[1], float)
-        items["dm"] = _value(lines[2], float)
-        items["fbot"] = _value(lines[3], float)
-        items["bandwidth"] = _value(lines[4], float)
-        items["nchan"] = _value(lines[5], int)
-        items["cbw"] = _value(lines[6], float)
-        items["analyst"] = _value(lines[7], str)
-    elif em_band in ("X-ray", "Gamma"):
-        items["fov_arcsec"] = _value(lines[1], float)
-        items["central_energy_kev"] = _value(lines[2], float)
-        items["energy_bandpass_kev"] = _value(lines[3], float)
-        items["analyst"] = _value(lines[4], str)
-    else:
-        raise ValueError(f"EM Band {em_band!r} not supported")
+    try:
+        if em_band == "Radio":
+            items["fov_arcsec"] = _value(lines[1], float)
+            items["dm"] = _value(lines[2], float)
+            items["fbot"] = _value(lines[3], float)
+            items["bandwidth"] = _value(lines[4], float)
+            items["nchan"] = _value(lines[5], int)
+            items["cbw"] = _value(lines[6], float)
+            items["analyst"] = _value(lines[7], str)
+        elif em_band in ("X-ray", "Gamma"):
+            items["fov_arcsec"] = _value(lines[1], float)
+            items["central_energy_kev"] = _value(lines[2], float)
+            items["energy_bandpass_kev"] = _value(lines[3], float)
+            items["analyst"] = _value(lines[4], str)
+        else:
+            raise ValueError(f"EM Band {em_band!r} not supported")
+    except IndexError:
+        raise ValueError(
+            f"truncated .inf header: incomplete {em_band!r} EM-band block"
+        ) from None
     return items
 
 
@@ -108,6 +120,15 @@ class PrestoInf(dict):
     def skycoord(self):
         return SkyCoord.from_radec_str(self["raj"], self["decj"])
 
-    def load_data(self):
-        """Time series samples as a float32 numpy array."""
-        return np.fromfile(self.data_fname, dtype=np.float32)
+    def load_data(self, policy="strict"):
+        """Time series samples as a float32 numpy array. The companion
+        .dat is validated against the header's sample count: a
+        truncated/odd-sized file raises under ``policy='strict'``, keeps
+        the whole-sample prefix under ``'salvage'``, or returns None
+        under ``'skip'`` (:mod:`riptide_tpu.quality`)."""
+        from ..quality import read_raw_samples
+
+        return read_raw_samples(
+            self.data_fname, dtype=np.float32, policy=policy,
+            expect=self.get("nsamp"),
+        )

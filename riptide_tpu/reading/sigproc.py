@@ -63,9 +63,35 @@ HEADER_END = "HEADER_END"
 parse_float_coord = parse_sigproc_float_coord
 
 
+# Upper bound on a length-prefixed header string. Legitimate SIGPROC
+# keys and values are tens of characters; a corrupt length prefix would
+# otherwise drive a huge read (and, downstream, a multi-GB data
+# allocation from garbage header ints).
+MAX_HEADER_STR = 1024
+
+
+def _read_exact(fobj, n):
+    raw = fobj.read(n)
+    if len(raw) != n:
+        raise ValueError(
+            f"truncated SIGPROC header: wanted {n} bytes, got {len(raw)}"
+        )
+    return raw
+
+
 def _read_str(fobj):
-    (size,) = struct.unpack("i", fobj.read(4))
-    return fobj.read(size).decode()
+    (size,) = struct.unpack("i", _read_exact(fobj, 4))
+    if not 0 < size <= MAX_HEADER_STR:
+        raise ValueError(
+            f"SIGPROC header string length {size} outside (0, "
+            f"{MAX_HEADER_STR}]: corrupt header"
+        )
+    try:
+        return _read_exact(fobj, size).decode()
+    except UnicodeDecodeError:
+        raise ValueError(
+            "SIGPROC header string is not valid text: corrupt header"
+        ) from None
 
 
 def read_sigproc_header(fobj, extra_keys=None):
@@ -99,15 +125,35 @@ def read_sigproc_header(fobj, extra_keys=None):
         if atype == str:
             attrs[key] = _read_str(fobj)
         elif atype == int:
-            (attrs[key],) = struct.unpack("i", fobj.read(4))
+            (attrs[key],) = struct.unpack("i", _read_exact(fobj, 4))
         elif atype == float:
-            (attrs[key],) = struct.unpack("d", fobj.read(8))
+            (attrs[key],) = struct.unpack("d", _read_exact(fobj, 8))
         elif atype == bool:
-            (v,) = struct.unpack("B", fobj.read(1))
+            (v,) = struct.unpack("B", _read_exact(fobj, 1))
             attrs[key] = bool(v)
         else:
             raise ValueError(f"Key {key!r} has unsupported type {atype!r}")
+    _validate_header_sanity(attrs)
     return attrs, fobj.tell()
+
+
+def _validate_header_sanity(attrs):
+    """Fail fast on physically-impossible header values so a corrupt
+    header raises here instead of driving a multi-GB allocation (or a
+    division by zero) downstream."""
+    nbits = attrs.get("nbits")
+    if nbits is not None and (nbits <= 0 or nbits % 8):
+        raise ValueError(f"corrupt SIGPROC header: nbits = {nbits}")
+    tsamp = attrs.get("tsamp")
+    if tsamp is not None and not tsamp > 0:
+        raise ValueError(f"corrupt SIGPROC header: tsamp = {tsamp}")
+    for key in ("nchans", "nifs"):
+        val = attrs.get(key)
+        if val is not None and val <= 0:
+            raise ValueError(f"corrupt SIGPROC header: {key} = {val}")
+    nsamples = attrs.get("nsamples")
+    if nsamples is not None and nsamples < 0:
+        raise ValueError(f"corrupt SIGPROC header: nsamples = {nsamples}")
 
 
 class SigprocHeader(dict):

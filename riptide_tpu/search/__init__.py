@@ -33,6 +33,8 @@ def ffa_search(
     rmed_width=4.0,
     rmed_minpts=101,
     already_normalised=False,
+    dq=True,
+    max_masked_frac=0.5,
 ):
     """
     Run an FFA search of a single TimeSeries, producing its periodogram.
@@ -54,6 +56,13 @@ def ffa_search(
       grows.
     - ducy_max, wtsp: boxcar width ladder parameters.
     - rmed_width, rmed_minpts: running median de-reddening parameters.
+    - dq: run the data-quality scan (riptide_tpu.quality) before
+      searching: NaN/Inf, clipped and dead samples are masked, repaired
+      with the local running median and excluded from the normalisation
+      (with the effective-nsamp S/N correction). A series whose masked
+      fraction exceeds max_masked_frac raises
+      :class:`riptide_tpu.quality.QuarantinedSeries` carrying the scan
+      report — its noise statistics cannot support a calibrated search.
 
     Returns
     -------
@@ -61,11 +70,29 @@ def ffa_search(
         The de-reddened, normalised series that was actually searched.
     pgram : Periodogram
     """
-    # Prepare data: deredden then normalise IN THAT ORDER
-    if deredden:
-        tseries = tseries.deredden(rmed_width, minpts=rmed_minpts)
-    if not already_normalised:
-        tseries = tseries.normalise()
+    if dq:
+        # The shared DQ preparation sequence (scan -> quarantine ->
+        # repair -> deredden -> mask-normalise with the effective-nsamp
+        # correction) lives in quality.prepare_time_series; this is the
+        # same code path the batch searcher runs.
+        from .. import quality
+
+        prepared, report = quality.prepare_time_series(
+            tseries,
+            rmed_width=rmed_width if deredden else None,
+            rmed_minpts=rmed_minpts,
+            dq=quality.DQConfig(max_masked_frac=max_masked_frac),
+            normalise=not already_normalised,
+        )
+        if prepared is None:
+            raise quality.QuarantinedSeries(report)
+        tseries = prepared
+    else:
+        # Prepare data: deredden then normalise IN THAT ORDER
+        if deredden:
+            tseries = tseries.deredden(rmed_width, minpts=rmed_minpts)
+        if not already_normalised:
+            tseries = tseries.normalise()
 
     widths = generate_width_trials(bins_min, ducy_max=ducy_max, wtsp=wtsp)
     plan = periodogram_plan(

@@ -43,7 +43,24 @@ from ..ops.snr import snr_batched
 
 __all__ = ["run_periodogram", "run_periodogram_batch", "run_search_batch",
            "queue_search_batch", "collect_search_batch", "search_snr_dev",
-           "cycle_fn"]
+           "cycle_fn", "is_oom_error"]
+
+
+# Substrings identifying device memory exhaustion in an exception
+# message: jaxlib surfaces OOM as XlaRuntimeError with a
+# RESOURCE_EXHAUSTED status string, and the fault injector's simulated
+# OOM carries the same marker.
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted", "out of memory")
+
+
+def is_oom_error(err):
+    """True when an exception looks like device memory exhaustion
+    (``XlaRuntimeError: RESOURCE_EXHAUSTED ...`` or any error whose
+    message carries an OOM marker). Used by the batcher's adaptive
+    bisection: OOM is recoverable by halving the DM batch, unlike other
+    dispatch failures which propagate to the retry machinery."""
+    msg = str(err).lower()
+    return any(marker in msg for marker in _OOM_MARKERS)
 
 
 def _pack(xd, p, m, R, P):

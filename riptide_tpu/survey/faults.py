@@ -1,13 +1,14 @@
 """
-Pluggable fault injection for the survey scheduler.
+Pluggable fault injection for the survey scheduler and batch searcher.
 
 Device faults on real hardware (transient dispatch errors, corrupted
-tunnel transfers, multi-second stalls) are rare and unreproducible, so
-the scheduler's robustness machinery is exercised instead through an
-injected :class:`FaultPlan`, configured from a spec string (CLI
+tunnel transfers, memory exhaustion, multi-second stalls) and degraded
+inputs (NaN blocks from upstream excision) are rare and unreproducible,
+so the robustness machinery is exercised instead through an injected
+:class:`FaultPlan`, configured from a spec string (CLI
 ``--fault-inject`` or the ``RIPTIDE_FAULT_INJECT`` environment
-variable). This keeps the retry/backoff and resume paths testable on
-the CPU backend.
+variable). This keeps the retry/backoff, resume, data-quality masking
+and OOM-bisection paths testable on the CPU backend.
 
 Spec grammar: comma-separated directives, each
 ``kind:chunk[:arg][xN]`` —
@@ -19,18 +20,31 @@ Spec grammar: comma-separated directives, each
   scheduler detects the digest mismatch and re-prepares);
 * ``abort:3``       raise a NON-retryable :class:`FaultAbort` on chunk 3
   (simulates a kill/preemption: completed chunks stay journaled and a
-  ``--resume`` run picks up from there).
+  ``--resume`` run picks up from there);
+* ``nan_inject:0``  overwrite a contiguous block of chunk 0's loaded
+  samples with NaN *before* the data-quality scan (arg = block
+  fraction, default 0.05; consumed once per loaded file, so ``xN``
+  covers N files of the chunk) — exercises the masking/repair path of
+  :mod:`riptide_tpu.quality`;
+* ``oom:4``         raise a simulated ``RESOURCE_EXHAUSTED`` whenever a
+  device batch LARGER than 4 DM trials dispatches (the "chunk" field is
+  a batch-size floor here, not a chunk id) — exercises the batcher's
+  adaptive bisection. ``oom:0`` fails the first full batch once;
+  ``oom:1x8`` keeps failing until batches bisect down to single trials.
 
-Example: ``RIPTIDE_FAULT_INJECT="stall:0:0.1,raise:2x2"``.
+Example: ``RIPTIDE_FAULT_INJECT="stall:0:0.1,raise:2x2,oom:0"``.
 """
 import logging
+import threading
 import time
 
-__all__ = ["FaultPlan", "FaultAbort", "InjectedFault"]
+import numpy as np
+
+__all__ = ["FaultPlan", "FaultAbort", "InjectedFault", "InjectedOOM"]
 
 log = logging.getLogger("riptide_tpu.survey.faults")
 
-_KINDS = ("raise", "stall", "corrupt", "abort")
+_KINDS = ("raise", "stall", "corrupt", "abort", "nan_inject", "oom")
 
 
 class InjectedFault(RuntimeError):
@@ -41,14 +55,29 @@ class FaultAbort(RuntimeError):
     """Injected fatal fault (not retryable): simulates a kill."""
 
 
+class InjectedOOM(RuntimeError):
+    """Simulated device memory exhaustion: the message carries the
+    RESOURCE_EXHAUSTED marker so it routes through the same
+    ``is_oom_error`` detection as a real ``XlaRuntimeError``."""
+
+    def __init__(self, batch_size, floor):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected device OOM on a "
+            f"{batch_size}-trial batch (floor {floor})"
+        )
+
+
 class FaultPlan:
-    """Parsed fault directives, consumed as the scheduler hits their
-    trigger points. ``sleep`` is injectable for tests."""
+    """Parsed fault directives, consumed as the scheduler/batcher hits
+    their trigger points. ``sleep`` is injectable for tests. Trigger
+    methods are thread-safe: the batcher's loader pool fires
+    ``nan_inject`` concurrently."""
 
     def __init__(self, directives=(), sleep=time.sleep):
         # directive: dict(kind, chunk, arg, remaining)
         self._directives = [dict(d) for d in directives]
         self._sleep = sleep
+        self._lock = threading.Lock()
 
     @classmethod
     def parse(cls, spec, sleep=time.sleep):
@@ -76,11 +105,12 @@ class FaultPlan:
         return cls(directives, sleep=sleep)
 
     def _take(self, kind, chunk_id):
-        for d in self._directives:
-            if d["kind"] == kind and d["chunk"] == chunk_id \
-                    and d["remaining"] > 0:
-                d["remaining"] -= 1
-                return d
+        with self._lock:
+            for d in self._directives:
+                if d["kind"] == kind and d["chunk"] == chunk_id \
+                        and d["remaining"] > 0:
+                    d["remaining"] -= 1
+                    return d
         return None
 
     # -- trigger points (called by the scheduler) ---------------------------
@@ -122,3 +152,41 @@ class FaultPlan:
             log.warning("fault injection: corrupted chunk %d's wire buffer",
                         chunk_id)
         return hit
+
+    # -- trigger points (called by the batch searcher) ----------------------
+
+    def nan_inject(self, chunk_id, data):
+        """Called per loaded file, BEFORE the data-quality scan:
+        overwrite a contiguous block of ``data`` (float array, modified
+        in place) with NaN. Block length is ``arg`` (default 0.05) of
+        the series; the block starts a third of the way in so it lands
+        well inside any detrending window. Returns True when injected."""
+        d = self._take("nan_inject", chunk_id)
+        if d is None or data.size == 0:
+            return False
+        frac = d["arg"] if d["arg"] is not None else 0.05
+        n = max(1, int(round(frac * data.size)))
+        start = min(data.size // 3, data.size - n)
+        data[start : start + n] = np.nan
+        log.warning(
+            "fault injection: NaN block of %d samples (%.1f%%) into a "
+            "chunk-%d series", n, 100.0 * n / data.size, chunk_id,
+        )
+        return True
+
+    def maybe_oom(self, batch_size):
+        """Called before every device-batch execution attempt: raise a
+        simulated RESOURCE_EXHAUSTED while an ``oom`` directive with a
+        batch-size floor below ``batch_size`` has firings left."""
+        with self._lock:
+            for d in self._directives:
+                if d["kind"] == "oom" and d["remaining"] > 0 \
+                        and batch_size > d["chunk"]:
+                    d["remaining"] -= 1
+                    floor = d["chunk"]
+                    break
+            else:
+                return
+        log.warning("fault injection: device OOM on a %d-trial batch",
+                    batch_size)
+        raise InjectedOOM(batch_size, floor)

@@ -131,10 +131,12 @@ class SurveyJournal:
         })
 
     def record_chunk(self, chunk_id, files, dms, peaks, wire_digest=None,
-                     timings=None, attempts=1):
+                     timings=None, attempts=1, dq=None):
         """Journal one completed chunk. The peak rows are appended (and
         fsync'd) BEFORE the chunk record, so a chunk record always
-        implies its peaks are durable."""
+        implies its peaks are durable. ``dq`` is the chunk's
+        data-quality summary (masked samples / quarantined files) for
+        downstream provenance."""
         offset = self._peak_store_len()
         _append_lines(self.peaks_path, [_peak_to_row(p) for p in peaks])
         self._peak_rows = offset + len(peaks)
@@ -145,6 +147,7 @@ class SurveyJournal:
             "wire_digest": wire_digest,
             "peaks_offset": offset, "peaks_count": len(peaks),
             "timings": timings or {}, "attempts": int(attempts),
+            "dq": dq or {},
         })
 
     def record_metrics(self, summary):

@@ -24,10 +24,17 @@ contains whatever was recorded):
 ``chunks_retried``        counter: chunk dispatch attempts beyond the first
 ``chunks_skipped``        counter: chunks satisfied from the journal on resume
 ``queue_depth``           gauge: work items not yet collected
+``dq_scanned_samples``    counter: samples through the data-quality scan
+``dq_masked_samples``     counter: samples masked by the scan
+``dq_ingest_nonfinite``   counter: non-finite samples seen at raw ingest
+``series_quarantined``    counter: series dropped for exceeding max_masked_frac
+``files_salvaged``        counter: malformed files read as a prefix (policy)
+``files_skipped``         counter: malformed files dropped (policy)
+``oom_bisections``        counter: DM-batch halvings after device OOM
 ========================  ====================================================
 
-Derived rates (e.g. ``wire_MBps``) are computed by :meth:`summary`, not
-stored.
+Derived rates (e.g. ``wire_MBps``, ``dq_masked_frac``) are computed by
+:meth:`summary`, not stored.
 """
 import threading
 import time
@@ -106,6 +113,11 @@ class MetricsRegistry:
         wire_bytes = out.get("wire_bytes")
         if wire_s and wire_bytes:
             out["wire_MBps"] = round(wire_bytes / 1e6 / wire_s, 3)
+        scanned = out.get("dq_scanned_samples")
+        if scanned:
+            out["dq_masked_frac"] = round(
+                out.get("dq_masked_samples", 0) / scanned, 6
+            )
         return out
 
     def reset(self):

@@ -103,6 +103,10 @@ def run_with_retry(work, chunk_id, retry, faults, metrics, on_retry=None):
         except FaultAbort:
             raise
         except Exception as err:
+            if not getattr(err, "retryable", True):
+                # e.g. QuarantinedSeries: re-dispatching cannot fix the
+                # data, so propagate instead of burning retries.
+                raise
             if attempt >= retry.max_retries:
                 log.error("chunk %d failed after %d attempts: %s",
                           chunk_id, attempt + 1, err)
@@ -175,12 +179,22 @@ class SurveyScheduler:
 
     # -- staging ------------------------------------------------------------
 
-    def _stage(self, loaders, fnames):
-        """Host half of one chunk: load + detrend + wire-prep. Returns
-        (tslist, items, digest) — tslist is retained so a corrupted
-        chunk can be re-prepared without re-reading files."""
+    def _stage(self, loaders, fnames, chunk_id):
+        """Host half of one chunk: load + DQ-scan/repair + detrend +
+        wire-prep. Returns (tslist, items, digest) — tslist is retained
+        so a corrupted chunk can be re-prepared without re-reading
+        files. Files skipped by the ingest policy or quarantined by the
+        data-quality scan load as None and are dropped here (the
+        journal's chunk record carries their DQ summary)."""
         with self.metrics.timer("chunk_prep_s"):
-            tslist = list(loaders.map(self.searcher.load_prepared, fnames))
+            tslist = [
+                ts for ts in loaders.map(
+                    lambda f: self.searcher.load_prepared(
+                        f, chunk_id=chunk_id),
+                    fnames,
+                )
+                if ts is not None
+            ]
             items = self.searcher._prepare_chunk(tslist)
         return tslist, items, _wire_digest(items)
 
@@ -243,6 +257,12 @@ class SurveyScheduler:
                                     expect)
                         continue
                     done[cid] = peaks
+                    # Replayed chunks never re-load their files: restore
+                    # their DQ provenance from the journal so data
+                    # products stay byte-identical to an uninterrupted
+                    # run.
+                    if hasattr(self.searcher, "restore_dq_reports"):
+                        self.searcher.restore_dq_reports(rec.get("dq"))
                 if done:
                     log.info("resuming: %d/%d chunks replayed from journal",
                              len(done), len(self.chunks))
@@ -254,14 +274,15 @@ class SurveyScheduler:
                 ThreadPoolExecutor(max_workers=self.searcher.io_threads) \
                 as loaders:
             staged = (stager.submit(self._stage, loaders,
-                                    self.chunks[pending[0]])
+                                    self.chunks[pending[0]], pending[0])
                       if pending else None)
             for k, cid in enumerate(pending):
                 self.metrics.set_gauge("queue_depth", len(pending) - k)
                 tslist, items, digest = staged.result()
                 if k + 1 < len(pending):
                     staged = stager.submit(
-                        self._stage, loaders, self.chunks[pending[k + 1]]
+                        self._stage, loaders, self.chunks[pending[k + 1]],
+                        pending[k + 1],
                     )
                 t0 = time.perf_counter()
                 self.faults.corrupt_wire(cid, items)
@@ -273,12 +294,15 @@ class SurveyScheduler:
                 self.metrics.add("chunks_done")
                 peaks_by_chunk[cid] = peaks
                 if self.journal is not None:
+                    dq = {}
+                    if hasattr(self.searcher, "chunk_dq_summary"):
+                        dq = self.searcher.chunk_dq_summary(self.chunks[cid])
                     self.journal.record_chunk(
                         cid, self.chunks[cid],
                         [float(ts.metadata["dm"] or 0.0) for ts in tslist],
                         peaks, wire_digest=digest,
                         timings={"chunk_s": round(chunk_s, 6)},
-                        attempts=attempts,
+                        attempts=attempts, dq=dq,
                     )
                 log.debug("chunk %d/%d done: %d peaks, %d attempt(s)",
                           cid + 1, len(self.chunks), len(peaks), attempts)

@@ -4,10 +4,12 @@ Reference contract: riptide/time_series.py. Data lives on the host as
 float32 numpy; device transfer happens inside the search/detrending ops.
 """
 import copy
+import os
 import warnings
 
 import numpy as np
 
+from . import quality
 from .folding import fold
 from .libffa import downsample, generate_signal
 from .metadata import Metadata
@@ -69,19 +71,29 @@ class TimeSeries:
     def copy(self):
         return copy.deepcopy(self)
 
-    def normalise(self, inplace=False):
+    def normalise(self, inplace=False, mask=None):
         """
         Normalise to zero mean and unit variance, with float64 accumulators
         to avoid saturation on large-valued data
         (riptide/time_series.py:66-90).
+
+        With a boolean bad-sample ``mask`` (see
+        :func:`riptide_tpu.quality.scan_samples`), the mean/std are
+        computed over unmasked samples only, masked samples are zeroed,
+        and the result is scaled by the effective-nsamp S/N correction
+        ``nsamp / n_good`` so partially-masked series stay on the clean
+        S/N scale (see :mod:`riptide_tpu.quality`).
         """
-        m = self.data.mean(dtype=np.float64)
-        v = self.data.var(dtype=np.float64)
+        m, v, n_good = quality.masked_moments(self.data, mask)
         norm = v**0.5
+        out = (self.data - m) / norm
+        if mask is not None and n_good < self.nsamp:
+            out[mask] = 0.0
+            out *= self.nsamp / n_good
         if inplace:
-            self._data = ((self.data - m) / norm).astype(np.float32)
+            self._data = out.astype(np.float32)
         else:
-            return TimeSeries((self.data - m) / norm, self.tsamp, metadata=self.metadata)
+            return TimeSeries(out, self.tsamp, metadata=self.metadata)
 
     @timing
     def deredden(self, width, minpts=101, inplace=False):
@@ -120,13 +132,16 @@ class TimeSeries:
         amplitude / stdnoise; see :func:`riptide_tpu.libffa.generate_signal`.
         """
         nsamp = int(round(length / tsamp))
-        data = generate_signal(
-            nsamp,
-            period / tsamp,
-            phi0=phi0,
-            ducy=ducy,
-            amplitude=amplitude,
-            stdnoise=stdnoise,
+        data = quality.ingest_scan(
+            generate_signal(
+                nsamp,
+                period / tsamp,
+                phi0=phi0,
+                ducy=ducy,
+                amplitude=amplitude,
+                stdnoise=stdnoise,
+            ),
+            source="TimeSeries.generate",
         )
         metadata = Metadata(
             {
@@ -142,31 +157,61 @@ class TimeSeries:
     @classmethod
     def from_numpy_array(cls, array, tsamp, copy=False):
         """From a plain array of samples."""
+        quality.ingest_scan(array, source="TimeSeries.from_numpy_array")
         return cls(array, tsamp, copy=copy)
 
     @classmethod
-    def from_binary(cls, fname, tsamp, dtype=np.float32):
-        """From a headerless binary file of raw samples."""
-        data = np.fromfile(fname, dtype=dtype)
+    def from_binary(cls, fname, tsamp, dtype=np.float32, policy="strict"):
+        """
+        From a headerless binary file of raw samples. Empty files and
+        byte sizes not divisible by the dtype itemsize are rejected with
+        a clear ValueError under the default ``policy='strict'``;
+        ``'salvage'`` keeps the readable whole-sample prefix and
+        ``'skip'`` returns None with a structured warning
+        (:mod:`riptide_tpu.quality`).
+        """
+        data = quality.read_raw_samples(fname, dtype=dtype, policy=policy)
+        if data is None:
+            return None
+        quality.ingest_scan(data, source=fname)
         return cls(data, tsamp, metadata=Metadata({"fname": fname}))
 
     @classmethod
-    def from_npy_file(cls, fname, tsamp):
-        """From a .npy array file."""
-        data = np.load(fname)
+    def from_npy_file(cls, fname, tsamp, policy="strict"):
+        """From a .npy array file. A truncated/malformed file raises
+        under ``policy='strict'`` and is skipped (returning None, with a
+        structured warning) under ``'salvage'`` or ``'skip'`` — a broken
+        .npy holds no readable prefix to salvage."""
+        try:
+            data = np.load(fname)
+        except Exception as err:
+            quality.report_malformed(
+                fname, f"not a readable .npy file ({err})", policy,
+                salvageable=False,
+            )
+            return None
+        quality.ingest_scan(data, source=fname)
         return cls(data, tsamp, metadata=Metadata({"fname": fname}))
 
     @classmethod
     @timing
-    def from_presto_inf(cls, fname):
+    def from_presto_inf(cls, fname, policy="strict"):
         """
         From a PRESTO .inf header (loads the companion .dat file). Warns
         on X-ray/Gamma data, whose white-noise statistics assumption does
-        not hold (riptide/time_series.py:283-316).
+        not hold (riptide/time_series.py:283-316). ``policy`` governs
+        truncated/malformed companion files: ``strict`` raises,
+        ``salvage`` keeps the readable prefix, ``skip`` returns None
+        (:mod:`riptide_tpu.quality`).
         """
         from .reading import PrestoInf
 
-        inf = PrestoInf(fname)
+        try:
+            inf = PrestoInf(fname)
+        except (ValueError, OSError) as err:
+            quality.report_malformed(fname, f"unreadable .inf header ({err})",
+                                     policy, salvageable=False)
+            return None
         metadata = Metadata.from_presto_inf(inf)
         if metadata.get("em_band", None) in ("X-ray", "Gamma"):
             warnings.warn(
@@ -174,35 +219,66 @@ class TimeSeries:
                 "Gaussian white noise, which photon-counting data generally "
                 "violate. Interpret S/N values with caution."
             )
-        return cls(inf.load_data(), metadata["tsamp"], metadata=metadata)
+        data = inf.load_data(policy=policy)
+        if data is None:
+            return None
+        quality.ingest_scan(data, source=inf.data_fname)
+        return cls(data, metadata["tsamp"], metadata=metadata)
 
     @classmethod
     @timing
-    def from_sigproc(cls, fname, extra_keys=None):
+    def from_sigproc(cls, fname, extra_keys=None, policy="strict"):
         """
         From a SIGPROC dedispersed time series (32-bit float, or 8-bit
         with the 'signed' header key; riptide/time_series.py:318-362).
+        ``policy`` governs corrupt headers and truncated payloads:
+        ``strict`` raises, ``salvage`` keeps the whole-sample prefix,
+        ``skip`` returns None (:mod:`riptide_tpu.quality`).
         """
         from .reading import SigprocHeader
 
         from . import native
 
-        sh = SigprocHeader(fname, extra_keys=extra_keys or {})
+        try:
+            sh = SigprocHeader(fname, extra_keys=extra_keys or {})
+        except (ValueError, KeyError, OSError) as err:
+            quality.report_malformed(fname, f"corrupt SIGPROC header ({err})",
+                                     policy, salvageable=False)
+            return None
         metadata = Metadata.from_sigproc(sh)
         nbits = sh["nbits"]
+        payload = os.path.getsize(fname) - sh.bytesize
+        if payload <= 0:
+            # Nothing to salvage: 'salvage' degrades to skip, 'strict'
+            # raises (inside report_malformed).
+            quality.report_malformed(fname, "no data payload", policy,
+                                     salvageable=False)
+            return None
+        rem = payload % sh.bytes_per_sample
+        if rem:
+            reason = (
+                f"{payload} payload bytes is not a multiple of the "
+                f"{sh.bytes_per_sample}-byte sample size ({rem} trailing "
+                "bytes)"
+            )
+            if not quality.report_malformed(fname, reason, policy,
+                                            salvageable=sh.nsamp > 0):
+                return None
+        nsamp = sh.nsamp
         if nbits == 32 and native.available():
-            data = native.read_f32(fname, sh.bytesize, sh.nsamp)
+            data = native.read_f32(fname, sh.bytesize, nsamp)
         else:
             with open(fname, "rb") as fobj:
                 fobj.seek(sh.bytesize)
                 if nbits == 32:
-                    data = np.fromfile(fobj, dtype=np.float32)
+                    data = np.fromfile(fobj, dtype=np.float32, count=nsamp)
                 elif native.available():
                     data = native.decode8(fobj.read(), signed=sh["signed"])
                 elif sh["signed"]:
                     data = np.fromfile(fobj, dtype=np.int8).astype(np.float32)
                 else:
                     data = np.fromfile(fobj, dtype=np.uint8).astype(np.float32)
+        quality.ingest_scan(data, source=fname)
         return cls(data, metadata["tsamp"], metadata=metadata)
 
     def to_dict(self):

@@ -1,0 +1,53 @@
+"""
+Tier-1 enforcement of the finite-guard discipline: every public entry
+point in ops/snr.py and time_series.py must route through the
+data-quality layer (tools/check_finite_guards.py), so a future kernel
+or reader cannot silently drop the NaN defence.
+"""
+import importlib.util
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+TOOL = os.path.join(REPO, "tools", "check_finite_guards.py")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_finite_guards", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_all_entry_points_guarded():
+    tool = _load_tool()
+    violations = tool.check()
+    assert violations == [], "\n".join(violations)
+
+
+def test_lint_catches_unguarded_entry_point(tmp_path):
+    """The checker must actually flag a module whose entry point skips
+    the quality layer (guard against a vacuous lint)."""
+    tool = _load_tool()
+    bad = tmp_path / "bad_snr.py"
+    bad.write_text(
+        "from .. import quality\n"
+        "def helper(x):\n"
+        "    return quality.check_finite_array(x)\n"
+        "def guarded(x):\n"
+        "    return helper(x)\n"
+        "def unguarded(x):\n"
+        "    return x.sum()\n"
+    )
+    violations = tool.check_module(str(bad), ["guarded", "unguarded"])
+    assert len(violations) == 1
+    assert "unguarded" in violations[0]
+    assert tool.check_module(str(bad), ["guarded"]) == []
+
+
+def test_lint_flags_missing_entry_point(tmp_path):
+    tool = _load_tool()
+    mod = tmp_path / "empty.py"
+    mod.write_text("x = 1\n")
+    violations = tool.check_module(str(mod), ["boxcar_snr"])
+    assert violations and "not found" in violations[0]

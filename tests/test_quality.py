@@ -1,0 +1,625 @@
+"""
+Degraded-input robustness tests: the data-quality scan/repair/quarantine
+layer (riptide_tpu.quality), strict|salvage|skip ingest policies on
+truncated/malformed files, NaN masking end-to-end through ffa_search and
+the batch searcher, and OOM-aware adaptive bisection of DM batches
+(fault-injected and monkeypatched).
+"""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from riptide_tpu import TimeSeries, ffa_search
+from riptide_tpu.quality import (
+    DegradedInputWarning,
+    DQConfig,
+    MalformedFile,
+    QuarantinedSeries,
+    fill_masked,
+    scan_samples,
+)
+from riptide_tpu.survey.faults import FaultPlan, InjectedOOM
+from riptide_tpu.survey.metrics import MetricsRegistry, set_metrics
+
+from synth import generate_data_presto, write_presto, write_sigproc
+
+TOBS = 16.0
+TSAMP = 1e-3
+PERIOD = 0.5
+
+DEREDDEN = {"rmed_width": 4.0, "rmed_minpts": 101}
+RANGES = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+
+@pytest.fixture
+def fresh_metrics():
+    m = MetricsRegistry()
+    prev = set_metrics(m)
+    yield m
+    set_metrics(prev)
+
+
+def make_searcher(**kwargs):
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+
+    return BatchSearcher(dict(DEREDDEN), RANGES, fmt="presto",
+                         io_threads=2, **kwargs)
+
+
+def make_survey(outdir, amplitudes):
+    return [
+        generate_data_presto(str(outdir), f"fake_DM{dm:.2f}", tobs=TOBS,
+                             tsamp=TSAMP, period=PERIOD, dm=dm, amplitude=amp)
+        for dm, amp in amplitudes.items()
+    ]
+
+
+# ---------------------------------------------------------------- scanning
+
+def test_scan_detects_nonfinite_clipping_dead(fresh_metrics):
+    rng = np.random.RandomState(0)
+    data = rng.normal(size=20000).astype(np.float32)
+    data[1000:1100] = data.max()    # 100-sample saturation run
+    data[5000:7000] = 0.125         # 2000-sample dead span
+    data[100:150] = np.nan          # non-finite block
+    data[200] = np.inf
+    cfg = DQConfig(clip_run_min=64, dead_run_min=1024)
+    mask, rep = scan_samples(data, cfg)
+    assert rep.n_nonfinite == 51
+    assert rep.n_clipped >= 100
+    assert rep.n_dead >= 2000
+    assert rep.n_masked == int(mask.sum())
+    assert mask[100] and mask[1050] and mask[6000]
+    assert not rep.quarantined
+    assert fresh_metrics.counter("dq_scanned_samples") == 20000
+    assert fresh_metrics.counter("dq_masked_samples") == rep.n_masked
+    d = rep.to_dict()
+    assert d["masked_frac"] == pytest.approx(rep.masked_frac, abs=1e-6)
+
+
+def test_scan_clean_noise_masks_nothing():
+    rng = np.random.RandomState(1)
+    data = rng.normal(size=50000).astype(np.float32)
+    mask, rep = scan_samples(data)
+    assert rep.n_masked == 0
+    assert not mask.any()
+    assert rep.reasons == []
+
+
+def test_scan_dc_dominated_block():
+    rng = np.random.RandomState(2)
+    data = rng.normal(size=40000).astype(np.float32)
+    data[8192:16384] += 100.0  # a grossly DC-offset block
+    cfg = DQConfig(dc_block=8192, dc_nstd=6.0)
+    mask, rep = scan_samples(data, cfg)
+    assert rep.n_dc >= 8192
+    assert mask[12000]
+    assert not mask[0]
+
+
+def test_fill_masked_uses_local_level():
+    rng = np.random.RandomState(3)
+    data = (rng.normal(size=8192) + np.linspace(0.0, 50.0, 8192)) \
+        .astype(np.float32)
+    mask = np.zeros(data.size, bool)
+    mask[4000:4100] = True
+    out = fill_masked(data, mask, width_samples=1001)
+    # good samples untouched, masked samples near the local trend (~25)
+    assert np.array_equal(out[~mask], data[~mask])
+    assert np.all(np.abs(out[mask] - data[3900:4000].mean()) < 5.0)
+
+
+def test_masked_normalise_effective_nsamp_correction():
+    rng = np.random.RandomState(4)
+    data = rng.normal(size=20000).astype(np.float32)
+    mask = np.zeros(data.size, bool)
+    mask[:2000] = True  # 10% masked
+    ts = TimeSeries(data, TSAMP)
+    out = ts.normalise(mask=mask)
+    assert np.all(out.data[mask] == 0.0)
+    # good samples: unit variance scaled by nsamp / n_good = 1 / 0.9
+    assert out.data[~mask].std() == pytest.approx(1.0 / 0.9, rel=1e-3)
+    assert abs(out.data[~mask].mean()) < 1e-3 / 0.9
+    # mask=None path is bit-identical to the historical normalise
+    clean = ts.normalise()
+    m = data.mean(dtype=np.float64)
+    v = data.var(dtype=np.float64)
+    assert np.array_equal(clean.data,
+                          ((data - m) / v**0.5).astype(np.float32))
+
+
+# ------------------------------------------------------- ingest policies
+
+def test_from_binary_rejects_empty_and_indivisible(tmp_path):
+    empty = tmp_path / "empty.bin"
+    empty.write_bytes(b"")
+    with pytest.raises(ValueError, match="empty"):
+        TimeSeries.from_binary(str(empty), TSAMP)
+
+    odd = tmp_path / "odd.bin"
+    odd.write_bytes(np.arange(8, dtype=np.float32).tobytes() + b"\x01\x02")
+    with pytest.raises(ValueError, match="not a multiple"):
+        TimeSeries.from_binary(str(odd), TSAMP)
+
+    with pytest.warns(DegradedInputWarning, match="salvaged"):
+        ts = TimeSeries.from_binary(str(odd), TSAMP, policy="salvage")
+    assert np.array_equal(ts.data, np.arange(8, dtype=np.float32))
+
+    with pytest.warns(DegradedInputWarning, match="skipped"):
+        assert TimeSeries.from_binary(str(odd), TSAMP, policy="skip") is None
+
+
+def test_from_npy_malformed(tmp_path):
+    bad = tmp_path / "bad.npy"
+    bad.write_bytes(b"\x93NUMPY garbage")
+    with pytest.raises(ValueError):
+        TimeSeries.from_npy_file(str(bad), TSAMP)
+    with pytest.warns(DegradedInputWarning):
+        assert TimeSeries.from_npy_file(str(bad), TSAMP,
+                                        policy="skip") is None
+
+
+def test_presto_truncated_dat_policies(tmp_path, fresh_metrics):
+    data = np.arange(64, dtype=np.float32)
+    inf = write_presto(str(tmp_path), "trunc", data, TSAMP, dm=1.0)
+    dat = os.path.join(str(tmp_path), "trunc.dat")
+    with open(dat, "r+b") as f:
+        f.truncate(16 * 4 + 2)  # 16 whole samples + 2 stray bytes
+
+    with pytest.raises(MalformedFile):
+        TimeSeries.from_presto_inf(inf)
+    with pytest.warns(DegradedInputWarning):
+        ts = TimeSeries.from_presto_inf(inf, policy="salvage")
+    assert np.array_equal(ts.data, data[:16])
+    with pytest.warns(DegradedInputWarning):
+        assert TimeSeries.from_presto_inf(inf, policy="skip") is None
+    assert fresh_metrics.counter("files_salvaged") == 1
+    assert fresh_metrics.counter("files_skipped") == 1
+
+
+def test_presto_truncated_inf_header(tmp_path):
+    inf = write_presto(str(tmp_path), "hdr",
+                       np.arange(16, dtype=np.float32), TSAMP)
+    with open(inf) as f:
+        head = f.read().splitlines()[:6]
+    with open(inf, "w") as f:
+        f.write("\n".join(head))
+    with pytest.raises(ValueError, match="truncated"):
+        from riptide_tpu.reading import PrestoInf
+
+        PrestoInf(inf)
+    with pytest.warns(DegradedInputWarning):
+        assert TimeSeries.from_presto_inf(inf, policy="skip") is None
+
+
+def test_sigproc_truncated_payload_policies(tmp_path):
+    data = np.arange(32, dtype=np.float32)
+    path = write_sigproc(str(tmp_path / "t.tim"), data, TSAMP, nbits=32)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 4 * 16 - 2)  # mid-sample cut
+
+    with pytest.raises(ValueError, match="not a multiple"):
+        TimeSeries.from_sigproc(path)
+    with pytest.warns(DegradedInputWarning):
+        ts = TimeSeries.from_sigproc(path, policy="salvage")
+    assert np.array_equal(ts.data, data[:15])
+    with pytest.warns(DegradedInputWarning):
+        assert TimeSeries.from_sigproc(path, policy="skip") is None
+
+
+def test_sigproc_corrupt_header_fails_fast(tmp_path):
+    # A giant length prefix must raise instead of attempting a huge read
+    path = str(tmp_path / "corrupt.tim")
+    with open(path, "wb") as f:
+        f.write(struct.pack("i", 0x7F000000) + b"HEAD")
+    from riptide_tpu.reading import SigprocHeader
+
+    with pytest.raises(ValueError, match="corrupt header"):
+        SigprocHeader(path)
+    # skip policy turns the same corruption into a structured skip
+    with pytest.warns(DegradedInputWarning):
+        assert TimeSeries.from_sigproc(path, policy="skip") is None
+
+
+@pytest.mark.parametrize("key,value", [("nbits", 0), ("tsamp", -1.0),
+                                       ("nchans", 0)])
+def test_sigproc_insane_header_values(tmp_path, key, value):
+    path = str(tmp_path / f"bad_{key}.tim")
+    kwargs = {"nbits": 32}
+    write_sigproc(path, np.arange(16, dtype=np.float32), TSAMP, **kwargs)
+    # Rewrite the header with the insane value via the raw format
+    raw = open(path, "rb").read()
+    fmt = {"nbits": "i", "tsamp": "d", "nchans": "i"}[key]
+    packed = struct.pack(fmt, {"nbits": 32, "tsamp": TSAMP, "nchans": 1}[key])
+    bad = struct.pack(fmt, value)
+    token = struct.pack("i", len(key)) + key.encode()
+    idx = raw.index(token) + len(token)
+    assert raw[idx : idx + len(packed)] == packed
+    with open(path, "wb") as f:
+        f.write(raw[:idx] + bad + raw[idx + len(packed):])
+    from riptide_tpu.reading import SigprocHeader
+
+    with pytest.raises(ValueError, match=key):
+        SigprocHeader(path)
+
+
+# ---------------------------------------------------- end-to-end masking
+
+def test_ffa_search_nan_block_snr_parity():
+    """THE degraded-input parity bar: a 5% contiguous NaN block must
+    still produce a finite periodogram whose top-peak S/N is within 3%
+    of the clean run (the effective-nsamp correction restores the clean
+    S/N scale)."""
+    np.random.seed(0)
+    ts = TimeSeries.generate(length=128.0, tsamp=256e-6, period=1.0,
+                             amplitude=20.0, ducy=0.02)
+    _, pg_clean = ffa_search(ts, period_min=0.5, period_max=2.0,
+                             bins_min=480, bins_max=520, ducy_max=0.3)
+    clean = float(pg_clean.snrs.max())
+
+    data = ts.data.copy()
+    n = data.size
+    blk = int(round(0.05 * n))
+    data[n // 3 : n // 3 + blk] = np.nan
+    with pytest.warns(DegradedInputWarning):
+        degraded = TimeSeries.from_numpy_array(data, 256e-6)
+    _, pg = ffa_search(degraded, period_min=0.5, period_max=2.0,
+                       bins_min=480, bins_max=520, ducy_max=0.3)
+    assert np.isfinite(pg.snrs).all()
+    masked = float(pg.snrs.max())
+    assert abs(masked - clean) / clean < 0.03
+    # the peak stays at the right period
+    ip, _ = np.unravel_index(np.argmax(pg.snrs), pg.snrs.shape)
+    assert abs(1.0 / pg.periods[ip] - 1.0) < 0.1 / 128.0
+
+
+def test_ffa_search_fully_nan_quarantined(fresh_metrics):
+    ts = TimeSeries(np.full(16000, np.nan, dtype=np.float32), TSAMP)
+    with pytest.warns(DegradedInputWarning):
+        with pytest.raises(QuarantinedSeries) as exc:
+            ffa_search(ts, period_min=0.3, period_max=1.2,
+                       bins_min=64, bins_max=71)
+    report = exc.value.report
+    assert report.quarantined
+    assert report.masked_frac == 1.0
+    assert report.n_nonfinite == 16000
+    assert "non-finite" in " ".join(report.reasons)
+    assert fresh_metrics.counter("series_quarantined") == 1
+
+
+def test_batcher_quarantines_bad_trial(tmp_path, fresh_metrics):
+    """A fully-NaN DM trial is dropped from the batch with a structured
+    report; the remaining trials still search normally."""
+    files = make_survey(tmp_path, {0.0: 15.0, 10.0: 40.0})
+    bad = write_presto(str(tmp_path), "fake_DM20.00",
+                       np.full(int(TOBS / TSAMP), np.nan, np.float32),
+                       TSAMP, dm=20.0)
+    bs = make_searcher()
+    with pytest.warns(DegradedInputWarning):
+        peaks = bs.process_fname_list(files + [bad])
+    assert peaks
+    best = max(peaks, key=lambda p: p.snr)
+    assert best.dm == 10.0
+    assert abs(best.period - PERIOD) < 1e-3
+    assert not any(p.dm == 20.0 for p in peaks)
+    assert fresh_metrics.counter("series_quarantined") == 1
+    rep = bs.dq_reports["fake_DM20.00.inf"]
+    assert rep.quarantined and rep.dm == 20.0
+
+
+def test_nan_inject_fault_masks_and_searches(tmp_path, fresh_metrics):
+    """The nan_inject fault kind corrupts loaded samples upstream of the
+    DQ scan; masking repairs them and the pulsar is still found."""
+    files = make_survey(tmp_path, {0.0: 15.0, 10.0: 40.0})
+    faults = FaultPlan.parse("nan_inject:0:0.05x2")
+    bs = make_searcher(faults=faults)
+    peaks = bs.process_fname_list(files)
+    assert peaks
+    best = max(peaks, key=lambda p: p.snr)
+    assert best.dm == 10.0
+    assert fresh_metrics.counter("dq_masked_samples") >= \
+        2 * int(0.05 * TOBS / TSAMP)
+    summary = fresh_metrics.summary()
+    assert summary["dq_masked_frac"] > 0.0
+
+
+# ------------------------------------------------- OOM-aware bisection
+
+def test_fault_plan_oom_and_nan_parse():
+    plan = FaultPlan.parse("oom:2x2,nan_inject:1:0.1")
+    with pytest.raises(InjectedOOM, match="RESOURCE_EXHAUSTED"):
+        plan.maybe_oom(4)
+    with pytest.raises(InjectedOOM):
+        plan.maybe_oom(3)
+    plan.maybe_oom(4)  # budget exhausted: no raise
+    plan.maybe_oom(2)  # at/below the floor: never raises
+    data = np.zeros(1000, np.float32)
+    assert plan.nan_inject(1, data)
+    assert np.isnan(data).sum() == 100
+    assert not plan.nan_inject(1, data)  # consumed
+
+
+def test_is_oom_error_matches_xla_and_injected():
+    from riptide_tpu.search.engine import is_oom_error
+
+    assert is_oom_error(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate ..."))
+    assert is_oom_error(InjectedOOM(8, 0))
+    assert not is_oom_error(RuntimeError("INVALID_ARGUMENT: bad shape"))
+
+
+def test_oom_bisection_fault_identical_peaks(tmp_path, fresh_metrics):
+    """An injected RESOURCE_EXHAUSTED on the full DM batch converges via
+    bisection to exactly the peaks of an unthrottled run, and records
+    the downshift in the metrics registry."""
+    amps = {0.0: 15.0, 5.0: 25.0, 10.0: 40.0, 15.0: 15.0}
+    files = make_survey(tmp_path, amps)
+
+    clean = make_searcher().process_fname_list(files)
+    baseline_bisections = fresh_metrics.counter("oom_bisections")
+    assert baseline_bisections == 0
+
+    throttled = make_searcher(faults=FaultPlan.parse("oom:2"))
+    peaks = throttled.process_fname_list(files)
+    assert fresh_metrics.counter("oom_bisections") >= 1
+    assert sorted(peaks) == sorted(clean)
+
+
+def test_oom_bisection_monkeypatched_collect(tmp_path, fresh_metrics,
+                                             monkeypatch):
+    """A RESOURCE_EXHAUSTED surfacing at collect time (the realistic
+    spot: queued device work fails when executed) also bisects to the
+    same peaks."""
+    import riptide_tpu.pipeline.batcher as batcher_mod
+
+    files = make_survey(tmp_path, {0.0: 15.0, 5.0: 25.0, 10.0: 40.0})
+    clean = make_searcher().process_fname_list(files)
+
+    real = batcher_mod.collect_search_batch
+    state = {"failed": False}
+
+    def failing_collect(handle, dms):
+        if not state["failed"]:
+            state["failed"] = True
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                "allocate 1234567890 bytes"
+            )
+        return real(handle, dms)
+
+    monkeypatch.setattr(batcher_mod, "collect_search_batch", failing_collect)
+    peaks = make_searcher().process_fname_list(files)
+    assert state["failed"]
+    assert fresh_metrics.counter("oom_bisections") >= 1
+    assert sorted(peaks) == sorted(clean)
+
+
+def test_oom_at_floor_propagates(tmp_path, fresh_metrics):
+    """OOM persisting at the bisection floor must propagate, not loop."""
+    files = make_survey(tmp_path, {0.0: 15.0, 10.0: 40.0})
+    bs = make_searcher(faults=FaultPlan.parse("oom:0x99"))
+    with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+        bs.process_fname_list(files)
+
+
+def test_scheduler_journal_records_dq_and_oom(tmp_path, fresh_metrics):
+    """Journaled survey with an injected full-batch OOM: chunk records
+    carry the DQ summary, the final metrics snapshot shows
+    oom_bisections, and the peaks match an unthrottled run."""
+    from riptide_tpu.survey.journal import SurveyJournal
+    from riptide_tpu.survey.scheduler import SurveyScheduler
+
+    amps = {0.0: 15.0, 5.0: 25.0, 10.0: 40.0, 15.0: 15.0}
+    files = make_survey(tmp_path, amps)
+    chunks = [files[:2], files[2:]]
+
+    clean = make_searcher().process_stream([list(c) for c in chunks])
+
+    faults = FaultPlan.parse("oom:1")
+    searcher = make_searcher(faults=faults)
+    scheduler = SurveyScheduler(
+        searcher, chunks, journal=SurveyJournal(tmp_path / "journal"),
+        faults=faults,
+    )
+    peaks = scheduler.run()
+    assert sorted(peaks) == sorted(clean)
+    assert fresh_metrics.counter("oom_bisections") >= 1
+
+    journal = SurveyJournal(tmp_path / "journal")
+    done = journal.completed_chunks()
+    assert sorted(done) == [0, 1]
+    for cid, (rec, _) in done.items():
+        assert "dq" in rec
+        assert rec["dq"].get("masked_samples", 0) == 0
+    metrics = journal.last_metrics()
+    assert metrics["oom_bisections"] >= 1
+    assert metrics["dq_scanned_samples"] > 0
+
+
+def test_boxcar_snr_eff_frac_correction():
+    """The host-level effective-nsamp correction on ops.snr.boxcar_snr:
+    S/N scales by 1/eff_frac; out-of-range values are rejected."""
+    from riptide_tpu.ops.snr import boxcar_snr
+
+    rng = np.random.RandomState(11)
+    profile = rng.normal(size=(3, 64)).astype(np.float32)
+    base = boxcar_snr(profile, [1, 2, 4])
+    corrected = boxcar_snr(profile, [1, 2, 4], eff_frac=0.95)
+    assert np.allclose(corrected, base / np.float32(0.95), rtol=1e-6)
+    with pytest.raises(ValueError, match="eff_frac"):
+        boxcar_snr(profile, [1, 2], eff_frac=0.0)
+
+
+def test_prepare_identity_path_leaves_metadata_untouched():
+    """Nothing to do (clean series, no detrend, no normalise) must hand
+    back the caller's object without growing provenance keys on it."""
+    from riptide_tpu.quality import prepare_time_series
+
+    rng = np.random.RandomState(12)
+    ts = TimeSeries(rng.normal(size=4096).astype(np.float32), TSAMP)
+    prep, report = prepare_time_series(ts, normalise=False)
+    assert prep is ts
+    assert report.n_masked == 0
+    assert "dq_masked_frac" not in ts.metadata
+    assert "dq_nsamp_eff" not in ts.metadata
+
+
+def test_candidate_reload_does_not_refire_faults(tmp_path, fresh_metrics):
+    """A candidate-rebuild reload (search=False) must neither consume
+    leftover nan_inject directives nor re-count DQ metrics: the folded
+    data must match what was searched."""
+    [f] = make_survey(tmp_path, {0.0: 40.0})
+    faults = FaultPlan.parse("nan_inject:0x5")
+    bs = make_searcher(faults=faults)
+    assert bs.load_prepared(f) is not None       # fires one injection
+    searched_report = bs.dq_reports["fake_DM0.00.inf"]
+    assert searched_report.n_masked > 0
+    scanned = fresh_metrics.counter("dq_scanned_samples")
+
+    ts2 = bs.load_prepared(f, search=False)      # rebuild reload
+    assert np.isfinite(ts2.data).all()
+    assert fresh_metrics.counter("dq_scanned_samples") == scanned
+    # the search-time report (with the injected mask) is retained
+    assert bs.dq_reports["fake_DM0.00.inf"] is searched_report
+    # directives were NOT consumed by the reload: 4 firings remain
+    assert sum(d["remaining"] for d in faults._directives) == 4
+
+
+def test_dq_by_dm_handles_missing_dm():
+    """A series without a DM files its provenance under 0.0 (the Peak
+    rows' fallback), and collisions keep the worst masked fraction."""
+    from riptide_tpu.quality import QualityReport
+
+    bs = make_searcher()
+    a = QualityReport(1000, fname="a.tim", dm=None)
+    a.n_masked = 100
+    b = QualityReport(1000, fname="b.tim", dm=0.0)
+    b.n_masked = 0
+    bs.dq_reports = {"a.tim": a, "b.tim": b}
+    assert bs.dq_by_dm() == {0.0: 0.1}
+
+
+def test_empty_file_salvage_degrades_to_skip(tmp_path, fresh_metrics):
+    """An empty file has no readable prefix: 'salvage' must skip it
+    (structured warning), not crash the run; only 'strict' raises."""
+    empty = tmp_path / "empty.bin"
+    empty.write_bytes(b"")
+    with pytest.warns(DegradedInputWarning):
+        assert TimeSeries.from_binary(str(empty), TSAMP,
+                                      policy="salvage") is None
+    assert fresh_metrics.counter("files_skipped") == 1
+    with pytest.raises(ValueError):
+        TimeSeries.from_binary(str(empty), TSAMP, policy="strict")
+
+
+def test_fully_masked_quarantined_even_at_max_frac_one(fresh_metrics):
+    """max_masked_frac=1.0 ('never quarantine by fraction') still cannot
+    make a fully-masked series searchable: it must quarantine with a
+    structured report, not crash in the repair."""
+    ts = TimeSeries(np.full(16000, np.nan, dtype=np.float32), TSAMP)
+    with pytest.warns(DegradedInputWarning):
+        with pytest.raises(QuarantinedSeries) as exc:
+            ffa_search(ts, period_min=0.3, period_max=1.2,
+                       bins_min=64, bins_max=71, max_masked_frac=1.0)
+    assert "no unmasked samples" in " ".join(exc.value.report.reasons)
+
+
+def test_prepare_already_normalised_still_corrects():
+    """normalise=False (externally-normalised input) must still zero
+    masked samples and apply the effective-nsamp correction."""
+    from riptide_tpu.quality import prepare_time_series
+
+    rng = np.random.RandomState(7)
+    data = rng.normal(size=20000).astype(np.float32)
+    data = ((data - data.mean()) / data.std()).astype(np.float32)
+    data[5000:6000] = np.inf  # 5% masked
+    ts = TimeSeries(data, TSAMP)
+    prepared, report = prepare_time_series(ts, normalise=False)
+    assert report.masked_frac == pytest.approx(0.05)
+    assert np.isfinite(prepared.data).all()
+    assert np.all(prepared.data[5000:6000] == 0.0)
+    good = np.ones(data.size, bool)
+    good[5000:6000] = False
+    # unit-variance input scaled by nsamp / n_good
+    assert prepared.data[good].std() == pytest.approx(1.0 / 0.95, rel=2e-3)
+    assert prepared.metadata["dq_nsamp_eff"] == 19000
+
+
+def test_resume_preserves_masked_frac_provenance(tmp_path, fresh_metrics):
+    """Kill-and-resume with a degraded (NaN-block) trial: the resumed
+    run restores per-file DQ reports from the journal, so peaks.csv
+    (including the masked_frac column) is byte-identical to an
+    uninterrupted run."""
+    from riptide_tpu.pipeline import Pipeline
+    from riptide_tpu.survey.faults import FaultAbort
+
+    indir = tmp_path / "data"
+    indir.mkdir()
+    files = make_survey(indir, {0.0: 40.0, 10.0: 40.0})
+    # Degrade the FIRST chunk's trial with a 5% NaN block: that chunk
+    # is journaled before the injected abort, so the resumed run must
+    # reproduce its masked_frac from the journal, not from a re-load.
+    dat = indir / "fake_DM0.00.dat"
+    arr = np.fromfile(dat, dtype=np.float32)
+    arr[len(arr) // 3 : len(arr) // 3 + len(arr) // 20] = np.nan
+    arr.tofile(dat)
+
+    conf = {
+        "processes": 1,  # one file per chunk -> 2 chunks
+        "data": {"format": "presto", "fmin": None, "fmax": None,
+                 "nchans": None},
+        "dmselect": {"min": 0.0, "max": 100.0, "dmsinb_max": None},
+        "dereddening": dict(DEREDDEN),
+        "ranges": [{"name": "r", "ffa_search": RANGES[0]["ffa_search"],
+                    "find_peaks": RANGES[0]["find_peaks"],
+                    "candidates": {"bins": 64, "subints": 8}}],
+        "clustering": {"radius": 0.2},
+        "harmonic_flagging": {"denom_max": 10, "phase_distance_max": 1.0,
+                              "dm_distance_max": 3.0,
+                              "snr_distance_max": 3.0},
+        "candidate_filters": {"dm_min": None, "snr_min": 7.0,
+                              "remove_harmonics": True, "max_number": None},
+        "plot_candidates": False,
+    }
+    out_a = tmp_path / "out_a"
+    out_a.mkdir()
+    with pytest.warns(DegradedInputWarning):
+        Pipeline(dict(conf)).process([str(f) for f in files], str(out_a))
+    peaks_a = (out_a / "peaks.csv").read_bytes()
+    assert b"masked_frac" in peaks_a
+
+    out_b = tmp_path / "out_b"
+    out_b.mkdir()
+    jdir = str(tmp_path / "journal")
+    with pytest.warns(DegradedInputWarning):
+        with pytest.raises(FaultAbort):
+            # Chunk 0 (the degraded trial) completes and journals;
+            # the abort kills the run on chunk 1's dispatch.
+            Pipeline(dict(conf), journal=jdir, fault_spec="abort:1") \
+                .process([str(f) for f in files], str(out_b))
+    Pipeline(dict(conf), journal=jdir, resume=True, fault_spec="") \
+        .process([str(f) for f in files], str(out_b))
+    assert (out_b / "peaks.csv").read_bytes() == peaks_a
+
+
+def test_rseek_nan_inject_survives(tmp_path, capsys):
+    """rseek with an injected NaN block masks, searches and still prints
+    the pulsar line."""
+    from riptide_tpu.apps.rseek import get_parser, run_program
+
+    inf = generate_data_presto(str(tmp_path), "fake_DM0.00", tobs=TOBS,
+                               tsamp=TSAMP, period=PERIOD, amplitude=40.0)
+    args = get_parser().parse_args([
+        "-f", "presto", "--Pmin", "0.3", "--Pmax", "1.2",
+        "--bmin", "64", "--bmax", "71", "--smin", "7.0",
+        "--fault-inject", "nan_inject:0:0.05", inf,
+    ])
+    df = run_program(args)
+    assert df is not None
+    assert abs(df.iloc[0]["period"] - PERIOD) < 1e-3
