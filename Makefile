@@ -2,10 +2,17 @@
 
 PYTHON ?= python
 
-.PHONY: install tests tests-cov native bench clean
+.PHONY: install check tests tests-cov native bench clean
 
 install:
 	$(PYTHON) -m pip install -e .
+
+# Static AST lints (also enforced in tier-1 via tests/): the finite-guard
+# discipline on data entry points and the bounded-wait discipline on
+# multi-host collectives.
+check:
+	$(PYTHON) tools/check_finite_guards.py
+	$(PYTHON) tools/check_liveness_guards.py
 
 # Run the test suite on the CPU backend (8 virtual devices). PYTHONPATH is
 # cleared so the axon TPU site customization does not claim the device for

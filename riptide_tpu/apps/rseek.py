@@ -80,6 +80,12 @@ def get_parser():
         "abort directives on chunk 0, e.g. 'raise:0' (see "
         "riptide_tpu.survey.faults); the search retries with backoff",
     )
+    parser.add_argument(
+        "--deadline-s", type=float, default=None,
+        help="Total wall-clock budget (seconds) for the search's retry "
+        "loop: attempts plus backoff never exceed it, so a persistently "
+        "failing search errors out instead of backing off forever",
+    )
     parser.add_argument("fname", type=str, help="Input file name")
     parser.add_argument("--version", action="version", version=__version__)
     return parser
@@ -146,9 +152,10 @@ def _search_with_survey_hooks(args, ts):
     # data-quality scan inside ffa_search, exercising the masking path.
     faults.nan_inject(0, ts.data)
     metrics = get_metrics()
+    retry = RetryPolicy(deadline_s=getattr(args, "deadline_s", None))
     t0 = time.perf_counter()
     peaks, attempts = run_with_retry(
-        lambda: _search_peaks(args, ts), 0, RetryPolicy(), faults, metrics,
+        lambda: _search_peaks(args, ts), 0, retry, faults, metrics,
     )
     metrics.add("chunks_done")
     metrics.observe("chunk_s", time.perf_counter() - t0)

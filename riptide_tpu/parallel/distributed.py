@@ -19,30 +19,74 @@ log = logging.getLogger("riptide_tpu.distributed")
 __all__ = ["init_distributed"]
 
 
-def init_distributed(coordinator_address=None, num_processes=None, process_id=None):
+def _is_initialized():
+    """Side-effect-free probe for an initialised distributed runtime.
+    Newer jax exposes ``jax.distributed.is_initialized``; on older
+    versions the equivalent is whether the global state holds a client
+    handle (probing via jax.process_count() would itself initialise the
+    XLA backend, after which initialize() refuses to run)."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    from jax._src import distributed as _distributed
+
+    return getattr(_distributed.global_state, "client", None) is not None
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None, initialization_timeout=None):
     """
     Join (or create) a multi-host JAX runtime. Safe to call unconditionally:
     a single-process run with no coordinator configured is a no-op.
 
     Arguments default to the standard JAX environment variables /
     cluster auto-detection (``jax.distributed.initialize`` semantics).
-    Returns True if a multi-process runtime was initialised.
+    ``initialization_timeout`` (seconds) bounds the wait for every
+    process to reach the coordinator — without it a missing peer stalls
+    startup indefinitely; with it the connect failure is re-raised with
+    the coordinator address named, so the operator knows *which*
+    endpoint never answered.
+
+    Returns the process count of the runtime (an int — truthiness is
+    compatible with the old boolean: 0 for a single-process no-op,
+    >= 2 when a multi-process runtime is up).
     """
-    # NB: probing via jax.process_count() would itself initialise the
-    # XLA backend, after which jax.distributed.initialize refuses to
-    # run; use the side-effect-free is_initialized().
-    if jax.distributed.is_initialized():
-        return jax.process_count() > 1
+    if _is_initialized():
+        n = jax.process_count()
+        return n if n > 1 else 0
     explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if explicit is None and num_processes is None:
-        return False
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+        return 0
+    kwargs = {}
+    if initialization_timeout is not None:
+        # jax takes integer seconds; round up so a sub-second request
+        # cannot truncate to an immediate 0-second timeout.
+        kwargs["initialization_timeout"] = max(
+            1, int(round(float(initialization_timeout)))
+        )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except Exception as err:
+        log.error(
+            "could not join the distributed runtime via coordinator %r "
+            "(process_id=%s, num_processes=%s): %s",
+            explicit, process_id, num_processes, err,
+        )
+        raise RuntimeError(
+            f"distributed init failed: coordinator {explicit!r} "
+            f"unreachable or peers missing ({err})"
+        ) from err
     log.info(
         "distributed runtime up: process %d/%d, %d global devices",
         jax.process_index(), jax.process_count(), jax.device_count(),
     )
-    return True
+    # Same contract as the already-initialized branch: a 1-process
+    # runtime is falsy (callers branch on truthiness to enable
+    # multi-host paths).
+    n = jax.process_count()
+    return n if n > 1 else 0

@@ -15,15 +15,63 @@ This is the TPU-native counterpart of the reference's tested
 worker processes, a multi-host JAX deployment runs one process per host
 with the coordinator wiring of ``init_distributed``; the exchange rides
 the distributed runtime's CPU collectives (DCN across hosts).
+
+Peer liveness: every collective here goes through the bounded-wait
+wrappers of :mod:`riptide_tpu.survey.liveness` (enforced by
+``tools/check_liveness_guards.py``), so a dead or wedged peer raises
+:class:`~riptide_tpu.survey.liveness.PeerTimeout` instead of
+deadlocking every process forever. On a peer loss the survivors
+*degrade to local-only mode*: collectives are skipped for the rest of
+the run, each process finishes (and journals) its own shards, the
+journal-writer role fails over from process 0 to the lowest alive
+process (per the heartbeat sidecars), and the lost shard's unfinished
+chunks can be re-enqueued from the journal
+(:meth:`PeerLivenessMonitor.unfinished_chunks`).
 """
+import logging
+
 import numpy as np
 
 import jax
 
 from ..peak_detection import PEAK_FIELDS, PEAK_INT_FIELDS, Peak
+from ..survey.liveness import PeerTimeout, bounded_allgather
 from ..survey.metrics import get_metrics
 
-__all__ = ["gather_peaks", "run_search_multihost"]
+log = logging.getLogger("riptide_tpu.multihost")
+
+__all__ = ["gather_peaks", "run_search_multihost", "is_degraded",
+           "reset_degraded"]
+
+# Once a peer is lost the distributed runtime cannot be trusted: any
+# further collective would hang on the dead peer (or desynchronise the
+# survivors). The flag is process-wide and sticky for the run.
+_degraded = False
+
+
+def is_degraded():
+    """True once this process has dropped to local-only mode after a
+    peer loss (collectives are skipped for the rest of the run)."""
+    return _degraded
+
+
+def reset_degraded():
+    """Clear local-only mode (tests only — a real run cannot rejoin a
+    runtime it stopped participating in)."""
+    global _degraded
+    _degraded = False
+
+
+def _degrade(reason):
+    global _degraded
+    if not _degraded:
+        log.error(
+            "peer loss detected (%s): degrading to local-only mode — "
+            "surviving processes finish their own shards and skip all "
+            "further collectives", reason,
+        )
+    _degraded = True
+    get_metrics().add("peer_losses")
 
 # Peak is a flat record of 8 numeric fields; encode/decode as float64
 # in the canonical PEAK_FIELDS order (shared with the survey journal).
@@ -49,32 +97,60 @@ def _decode(arr):
     return out
 
 
-def gather_peaks(local_peaks):
+def _allgather(arr, timeout_s, what):
+    """Single chokepoint for the gather collectives (monkeypatchable in
+    tests); delegates to the liveness layer's bounded wrapper."""
+    return bounded_allgather(arr, timeout_s=timeout_s, what=what)
+
+
+def gather_peaks(local_peaks, faults=None, chunk_id=0, timeout_s=None,
+                 monitor=None):
     """All-gather Peak lists across every process of the distributed
     runtime; every process returns the identical concatenated list
-    (process order, then local order). Single-process: a plain copy."""
-    local_peaks = list(local_peaks)
-    if jax.process_count() == 1:
-        return local_peaks
-    from jax.experimental import multihost_utils
+    (process order, then local order). Single-process: a plain copy.
 
-    with get_metrics().timer("gather_s"):
-        arr = _encode(local_peaks)
-        counts = multihost_utils.process_allgather(
-            np.asarray([arr.shape[0]], np.int64)
-        ).reshape(-1)
-        mx = max(int(counts.max()), 1)
-        padded = np.zeros((mx, len(_FIELDS)), np.float64)
-        padded[: arr.shape[0]] = arr
-        gathered = multihost_utils.process_allgather(padded)
-        out = []
-        for cnt, block in zip(counts, gathered):
-            out.extend(_decode(block[: int(cnt)]))
+    Every collective runs under a bounded wait of ``timeout_s`` seconds
+    (None = unbounded). When one times out — or an injected
+    ``peer_loss`` fault fires — the process *degrades to local-only
+    mode*: ``peer_losses`` is counted, the flag is sticky for the rest
+    of the run (subsequent gathers skip collectives entirely), and the
+    LOCAL peak list is returned so this process can still finish and
+    journal its own shard.
+    """
+    local_peaks = list(local_peaks)
+    if jax.process_count() == 1 or _degraded:
+        return local_peaks
+
+    try:
+        if faults is not None:
+            faults.before_gather(chunk_id)
+        with get_metrics().timer("gather_s"):
+            arr = _encode(local_peaks)
+            counts = _allgather(
+                np.asarray([arr.shape[0]], np.int64), timeout_s,
+                f"peak-count allgather (chunk {chunk_id})",
+            ).reshape(-1)
+            mx = max(int(counts.max()), 1)
+            padded = np.zeros((mx, len(_FIELDS)), np.float64)
+            padded[: arr.shape[0]] = arr
+            gathered = _allgather(
+                padded, timeout_s, f"peak allgather (chunk {chunk_id})",
+            )
+            out = []
+            for cnt, block in zip(counts, gathered):
+                out.extend(_decode(block[: int(cnt)]))
+    except PeerTimeout as err:
+        _degrade(err)
+        if monitor is not None:
+            monitor.peer_ages()  # refresh the heartbeat_age_s gauge
+        return local_peaks
     return out
 
 
 def run_search_multihost(plan, batch_local, tobs, dms_local=None,
-                         journal=None, chunk_id=0, **peak_kwargs):
+                         journal=None, chunk_id=0, faults=None,
+                         gather_timeout_s=None, monitor=None,
+                         **peak_kwargs):
     """
     Search this process's local DM-trial batch and exchange results:
     returns (peaks, polycos_local) where ``peaks`` is the SAME global
@@ -82,14 +158,25 @@ def run_search_multihost(plan, batch_local, tobs, dms_local=None,
     ``polycos_local`` are this process's per-trial threshold
     polynomials.
 
-    When a :class:`~riptide_tpu.survey.SurveyJournal` is given, process
-    0 — and ONLY process 0, so a shared journal directory sees exactly
-    one writer — records the gathered result as chunk ``chunk_id``
-    together with a metrics snapshot. Every process returns the same
-    peaks, so the single-writer record is complete.
+    When a :class:`~riptide_tpu.survey.SurveyJournal` is given, exactly
+    one process — the *journal writer* — records the gathered result as
+    chunk ``chunk_id`` together with a metrics snapshot, so a shared
+    journal directory sees a single writer. The writer is process 0;
+    with a :class:`~riptide_tpu.survey.liveness.PeerLivenessMonitor`
+    the role fails over to the lowest alive process when heartbeats go
+    stale (so losing process 0 does not stop journaling).
+
+    The peak exchange runs under ``gather_timeout_s``-bounded
+    collectives; a peer loss degrades this process to local-only mode
+    (see :func:`gather_peaks`): the returned ``peaks`` then cover only
+    the local shard, which is exactly what the surviving process must
+    finish and journal. A survivor can then re-enqueue the lost shard's
+    unfinished chunks via ``monitor.unfinished_chunks``.
     """
     from ..search.engine import run_search_batch
 
+    if monitor is not None:
+        monitor.beat()
     D = np.asarray(batch_local).shape[0]
     if dms_local is None:
         dms_local = np.zeros(D)
@@ -97,12 +184,34 @@ def run_search_multihost(plan, batch_local, tobs, dms_local=None,
         plan, batch_local, tobs=tobs, dms=dms_local, **peak_kwargs
     )
     flat = [p for trial in peaks_per_trial for p in trial]
-    peaks = sorted(gather_peaks(flat), key=lambda p: p.snr, reverse=True)
-    if journal is not None and jax.process_index() == 0:
+    peaks = sorted(
+        gather_peaks(flat, faults=faults, chunk_id=chunk_id,
+                     timeout_s=gather_timeout_s, monitor=monitor),
+        key=lambda p: p.snr, reverse=True,
+    )
+    writer = 0
+    extra = None
+    if _degraded:
+        # A degraded record holds only THIS process's shard: mark it so
+        # the journal is honest about its scope. With more than two
+        # processes the OTHER survivors' peaks for this chunk id are
+        # not merged (no collectives in degraded mode) — each survivor
+        # must finish and account for its own shards.
+        extra = {"scope": "local", "process": int(jax.process_index())}
+        if jax.process_count() > 2:
+            log.warning(
+                "degraded chunk %d record covers only process %d's "
+                "local shard; peaks searched by other surviving "
+                "processes are NOT merged into this journal record",
+                chunk_id, jax.process_index(),
+            )
+        if monitor is not None:
+            writer = monitor.journal_writer()
+    if journal is not None and jax.process_index() == writer:
         metrics = get_metrics()
         journal.record_chunk(
             chunk_id, files=[], dms=[float(d) for d in np.ravel(dms_local)],
-            peaks=peaks,
+            peaks=peaks, extra=extra,
         )
         journal.record_metrics(metrics.summary())
         metrics.add("chunks_done")

@@ -23,6 +23,7 @@ move file paths in and small Peak lists out of its workers
 """
 import logging
 import os
+import time
 from collections import defaultdict
 from concurrent.futures import ThreadPoolExecutor
 
@@ -68,6 +69,11 @@ class BatchSearcher:
     oom_floor : int
         Smallest DM sub-batch the OOM bisection will retry; a batch
         that still exhausts device memory at this size propagates.
+    watchdog : ChunkWatchdog or None
+        Liveness watchdog shared with the survey scheduler: the stream
+        path feeds each chunk's wall time into its duration EWMA, so
+        deadline budgets are primed even before (or without) a
+        journaled scheduler run.
     """
 
     TIMESERIES_LOADERS = {
@@ -77,7 +83,7 @@ class BatchSearcher:
 
     def __init__(self, deredden_params, range_confs, fmt="presto",
                  io_threads=4, mesh=None, batch_size=None, dq=None,
-                 faults=None, oom_floor=1):
+                 faults=None, oom_floor=1, watchdog=None):
         self.deredden_params = deredden_params
         self.range_confs = range_confs
         self.loader = self.TIMESERIES_LOADERS[fmt]
@@ -90,6 +96,7 @@ class BatchSearcher:
         self.dq = quality.DQConfig.from_any(dq)
         self.faults = faults
         self.oom_floor = max(1, int(oom_floor))
+        self.watchdog = watchdog
         # basename -> QualityReport of every file this searcher loaded
         # (quarantined ones included); read by the pipeline for the
         # peaks.csv/candidates provenance columns and by the scheduler
@@ -198,9 +205,21 @@ class BatchSearcher:
                 items = self._prepare_chunk(tslist)
                 return shipper.submit(self._ship_chunk, items)
 
+            def drain(queued, t_queued):
+                peaks.extend(self._collect_chunk(queued))
+                metrics.add("chunks_done")
+                if self.watchdog is not None:
+                    # Prime the liveness EWMA with this chunk's queue->
+                    # collect wall time, so a later journaled run (the
+                    # watchdog-guarded path) starts with a calibrated
+                    # deadline budget instead of an unbounded first
+                    # dispatch.
+                    self.watchdog.observe(time.perf_counter() - t_queued)
+
             pending = (stager.submit(stage_chunk, chunks[0], 0)
                        if chunks else None)
             queued = None
+            t_queued = 0.0
             for i, chunk in enumerate(chunks):
                 metrics.set_gauge("queue_depth", len(chunks) - i)
                 ship_fut = pending.result()   # prep done, ship submitted
@@ -210,18 +229,17 @@ class BatchSearcher:
                 # Queue chunk i's device work BEFORE collecting chunk
                 # i-1: the device stays busy while the host pays the
                 # previous chunk's result round trip.
+                t_nxt = time.perf_counter()
                 nxt = self._queue_chunk(items)
                 if queued is not None:
-                    peaks.extend(self._collect_chunk(queued))
-                    metrics.add("chunks_done")
-                queued = nxt
+                    drain(queued, t_queued)
+                queued, t_queued = nxt, t_nxt
                 log.debug(
                     f"Chunk {i + 1}/{len(chunks)} ({len(chunk)} files) "
                     f"queued, total peaks: {len(peaks)}"
                 )
             if queued is not None:
-                peaks.extend(self._collect_chunk(queued))
-                metrics.add("chunks_done")
+                drain(queued, t_queued)
             metrics.set_gauge("queue_depth", 0)
         return peaks
 

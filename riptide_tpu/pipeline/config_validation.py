@@ -212,6 +212,43 @@ PIPELINE_CONFIG_SPEC = {
         "oom_floor": Field(_strict_int, _pos,
                            "oom_floor must be an int > 0", optional=True),
     }),
+    # Optional liveness layer (riptide_tpu.survey.liveness): watchdog
+    # deadlines around chunk dispatch, a total retry budget and a
+    # circuit breaker that parks persistently failing chunks. Omitted
+    # keys fall back to the ChunkWatchdog / RetryPolicy /
+    # CircuitBreaker defaults; the section only takes effect for
+    # journaled (--journal) runs, which are the long-lived ones.
+    "liveness": Section({
+        "enabled": Field(_strict_bool, error="enabled must be a boolean",
+                         optional=True),
+        "watchdog_k": Field(_number, lambda x: x > 1,
+                            "watchdog_k must be a number > 1",
+                            optional=True),
+        "watchdog_floor_s": Field(_number, _pos,
+                                  "watchdog_floor_s must be a number > 0",
+                                  optional=True),
+        "watchdog_cap_s": Field(_number, _pos,
+                                "watchdog_cap_s must be a number > 0",
+                                optional=True),
+        "watchdog_initial_s": Field(
+            _number, _pos,
+            "watchdog_initial_s must be a number > 0 or null/blank",
+            optional=True, nullable=True,
+        ),
+        "retry_deadline_s": Field(
+            _number, _pos,
+            "retry_deadline_s must be a number > 0 or null/blank",
+            optional=True, nullable=True,
+        ),
+        "breaker_threshold": Field(
+            _strict_int, _pos, "breaker_threshold must be an int > 0",
+            optional=True,
+        ),
+        "breaker_cooldown_s": Field(
+            _number, _pos, "breaker_cooldown_s must be a number > 0",
+            optional=True,
+        ),
+    }),
     "ranges": [SEARCH_RANGE_SPEC],
     "clustering": {
         "radius": Field(_number, _pos, "clustering radius must be a number > 0"),

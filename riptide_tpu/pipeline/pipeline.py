@@ -131,12 +131,13 @@ class Pipeline:
         self.fault_spec = (fault_spec if fault_spec is not None
                            else os.environ.get("RIPTIDE_FAULT_INJECT"))
         # ONE fault plan shared by the scheduler (raise/stall/abort/
-        # corrupt kinds) and the batch searcher (nan_inject/oom kinds),
-        # so directive budgets are consumed consistently. Parsing here
-        # also fails fast on a bad spec.
+        # corrupt/hang/straggle kinds) and the batch searcher
+        # (nan_inject/oom kinds), so directive budgets are consumed
+        # consistently. Parsing here also fails fast on a bad spec.
         self.faults = FaultPlan.parse(self.fault_spec)
         if self.resume and not self.journal_dir:
             raise ValueError("resume=True requires a journal directory")
+        self.watchdog, self.breaker, self.retry = self._build_liveness()
         self.dmiter = None
         self.searcher = None
         self.peaks = []
@@ -145,6 +146,33 @@ class Pipeline:
         self.candidates = []
 
     # -- config helpers -----------------------------------------------------
+
+    def _build_liveness(self):
+        """(watchdog, breaker, retry) from the optional ``liveness``
+        config section (see docs/fault_tolerance.md). The layer is ON
+        by default — an absent section gets the documented defaults,
+        matching example.yaml — and ``liveness: {enabled: false}``
+        returns (None, None, None), reverting the scheduler to its
+        legacy retry-only behaviour."""
+        liv = self.config.get("liveness") or {}
+        if not liv.get("enabled", True):
+            return None, None, None
+        from ..survey.liveness import ChunkWatchdog
+        from ..survey.scheduler import CircuitBreaker, RetryPolicy
+
+        watchdog = ChunkWatchdog(
+            k=liv.get("watchdog_k", 4.0),
+            floor_s=liv.get("watchdog_floor_s", 5.0),
+            cap_s=liv.get("watchdog_cap_s", 900.0),
+            initial_s=liv.get("watchdog_initial_s"),
+        )
+        breaker = CircuitBreaker(
+            failure_threshold=liv.get("breaker_threshold", 3),
+            cooldown_s=liv.get("breaker_cooldown_s", 60.0),
+        )
+        retry = (RetryPolicy(deadline_s=liv["retry_deadline_s"])
+                 if liv.get("retry_deadline_s") is not None else None)
+        return watchdog, breaker, retry
 
     def wmin(self):
         """Minimum pulse width searched across all ranges."""
@@ -219,6 +247,7 @@ class Pipeline:
             dq=dq_conf,
             faults=self.faults,
             oom_floor=oom_floor,
+            watchdog=self.watchdog,
         )
         log.info("Pipeline ready")
 
@@ -257,8 +286,11 @@ class Pipeline:
             self.searcher, chunks,
             journal=SurveyJournal(self.journal_dir),
             resume=self.resume,
+            retry=self.retry,
             faults=self.faults,
             survey_id=survey_id,
+            watchdog=self.watchdog,
+            breaker=self.breaker,
         )
         return scheduler.run()
 

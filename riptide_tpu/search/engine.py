@@ -43,7 +43,7 @@ from ..ops.snr import snr_batched
 
 __all__ = ["run_periodogram", "run_periodogram_batch", "run_search_batch",
            "queue_search_batch", "collect_search_batch", "search_snr_dev",
-           "cycle_fn", "is_oom_error"]
+           "cycle_fn", "is_oom_error", "is_timeout_error"]
 
 
 # Substrings identifying device memory exhaustion in an exception
@@ -61,6 +61,13 @@ def is_oom_error(err):
     dispatch failures which propagate to the retry machinery."""
     msg = str(err).lower()
     return any(marker in msg for marker in _OOM_MARKERS)
+
+
+# The deadline-side counterpart of is_oom_error: a wedged device queue
+# surfaces as XlaRuntimeError DEADLINE_EXCEEDED, and the survey
+# watchdog's ChunkTimeout carries the same marker — both classify as a
+# hang (retryable, counted as chunks_timed_out by the retry loop).
+from ..survey.liveness import is_timeout_error  # noqa: E402
 
 
 def _pack(xd, p, m, R, P):

@@ -14,6 +14,9 @@ and observable:
   per-chunk retry (exponential backoff + jitter) and kill-and-resume;
 * :mod:`riptide_tpu.survey.faults` — env/config-driven fault injection
   so the robustness machinery is testable on the CPU backend;
+* :mod:`riptide_tpu.survey.liveness` — deadline-driven hang detection
+  (watchdog + duration EWMA), bounded waits around multi-host
+  collectives, and heartbeat-based peer-loss detection;
 * :mod:`riptide_tpu.survey.metrics` — lightweight counters/timers
   threaded through the engine, batcher, pipeline and multihost layers.
 
@@ -27,9 +30,14 @@ _LAZY = {
     "JournalMismatch": "journal",
     "SurveyScheduler": "scheduler",
     "RetryPolicy": "scheduler",
+    "CircuitBreaker": "scheduler",
     "TransientChunkError": "scheduler",
     "FaultPlan": "faults",
     "FaultAbort": "faults",
+    "ChunkWatchdog": "liveness",
+    "ChunkTimeout": "liveness",
+    "PeerTimeout": "liveness",
+    "PeerLivenessMonitor": "liveness",
     "MetricsRegistry": "metrics",
     "get_metrics": "metrics",
 }

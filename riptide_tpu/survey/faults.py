@@ -31,6 +31,18 @@ Spec grammar: comma-separated directives, each
   a batch-size floor here, not a chunk id) — exercises the batcher's
   adaptive bisection. ``oom:0`` fails the first full batch once;
   ``oom:1x8`` keeps failing until batches bisect down to single trials.
+* ``hang:2:5``      wedge chunk 2's dispatch for 5 s *inside* the
+  watchdog-guarded region (unlike ``stall``, which fires before the
+  deadline starts): with a watchdog whose budget is below 5 s the
+  attempt is abandoned, counted as ``chunks_timed_out`` and retried;
+* ``straggle:1:0.2``  slow chunk 1's dispatch by 0.2 s, again inside
+  the guarded region — a *straggler* that must NOT be killed while it
+  stays within the deadline (and whose duration feeds the EWMA, so
+  budgets adapt to genuinely slower chunks);
+* ``peer_loss:3``   raise :class:`InjectedPeerLoss` at chunk 3's peak
+  gather, simulating a bounded collective timing out on a dead peer —
+  the multihost layer degrades to local-only mode (see
+  riptide_tpu/parallel/multihost.py).
 
 Example: ``RIPTIDE_FAULT_INJECT="stall:0:0.1,raise:2x2,oom:0"``.
 """
@@ -40,11 +52,15 @@ import time
 
 import numpy as np
 
-__all__ = ["FaultPlan", "FaultAbort", "InjectedFault", "InjectedOOM"]
+from .liveness import PeerTimeout
+
+__all__ = ["FaultPlan", "FaultAbort", "InjectedFault", "InjectedOOM",
+           "InjectedPeerLoss"]
 
 log = logging.getLogger("riptide_tpu.survey.faults")
 
-_KINDS = ("raise", "stall", "corrupt", "abort", "nan_inject", "oom")
+_KINDS = ("raise", "stall", "corrupt", "abort", "nan_inject", "oom",
+          "hang", "straggle", "peer_loss")
 
 
 class InjectedFault(RuntimeError):
@@ -53,6 +69,19 @@ class InjectedFault(RuntimeError):
 
 class FaultAbort(RuntimeError):
     """Injected fatal fault (not retryable): simulates a kill."""
+
+
+class InjectedPeerLoss(PeerTimeout):
+    """Simulated dead-peer collective timeout: subclasses
+    :class:`~riptide_tpu.survey.liveness.PeerTimeout` so the multihost
+    layer's peer-loss handling routes injected and real losses
+    identically."""
+
+    def __init__(self, chunk_id):
+        super().__init__(
+            f"injected peer loss at chunk {chunk_id}'s gather "
+            "(simulated bounded-collective timeout)"
+        )
 
 
 class InjectedOOM(RuntimeError):
@@ -131,6 +160,34 @@ class FaultPlan:
             log.warning("fault injection: transient error on chunk %d",
                         chunk_id)
             raise InjectedFault(f"injected device error on chunk {chunk_id}")
+
+    def in_flight(self, chunk_id):
+        """Called inside the watchdog-guarded dispatch region (the
+        sacrificial attempt thread): ``hang`` and ``straggle``
+        directives sleep here. The two kinds are identical mechanically
+        — a blocking sleep — and differ by intent: a ``hang``'s
+        duration is chosen to blow through the watchdog budget (the
+        attempt is abandoned and retried), a ``straggle``'s to stay
+        within it (the attempt must complete and its duration feed the
+        EWMA)."""
+        for kind, default_s in (("hang", 30.0), ("straggle", 1.0)):
+            d = self._take(kind, chunk_id)
+            if d is not None:
+                secs = d["arg"] if d["arg"] is not None else default_s
+                log.warning("fault injection: %s %.3fs inside chunk %d's "
+                            "dispatch", kind, secs, chunk_id)
+                self._sleep(secs)
+
+    def before_gather(self, chunk_id):
+        """Called before a chunk's multi-host peak gather touches any
+        collective: a ``peer_loss`` directive raises
+        :class:`InjectedPeerLoss`, standing in for a bounded collective
+        timing out on a dead peer (the real collective must NOT run —
+        with the peer gone it would deadlock)."""
+        if self._take("peer_loss", chunk_id) is not None:
+            log.warning("fault injection: peer loss at chunk %d's gather",
+                        chunk_id)
+            raise InjectedPeerLoss(chunk_id)
 
     def corrupt_wire(self, chunk_id, items):
         """Called once per chunk after host preparation: flips the first

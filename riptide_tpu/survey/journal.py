@@ -7,8 +7,16 @@ Two JSONL files live in the journal directory:
   survey (an identity digest over the input files and search config,
   so a journal cannot silently resume a different survey), one
   ``chunk`` record per completed work unit (chunk id, input files, DM
-  values, wire digest, peak-store offsets, attempt count, timings) and
-  optional ``metrics`` snapshots.
+  values, wire digest, peak-store offsets, attempt count, timings),
+  ``parked`` records for chunks the circuit breaker set aside (a
+  parked chunk has no completed record, so a later resume re-dispatches
+  it) and optional ``metrics`` snapshots.
+
+Per-process ``heartbeat_<p>.jsonl`` sidecars carry liveness beats for
+multi-host peer-loss detection: each process appends only to its OWN
+sidecar (no cross-process write contention on shared storage) and the
+:class:`~riptide_tpu.survey.liveness.PeerLivenessMonitor` reads them
+all to decide who is alive and who writes the shared journal.
 * ``peaks.jsonl`` — the peak store: one line per peak, eight numeric
   fields in :data:`PEAK_FIELDS` order, full float precision (JSON
   round-trips float64 exactly), so a resumed survey reproduces
@@ -83,6 +91,28 @@ def _read_lines(path):
     return out
 
 
+def _read_last_record(path, tail_bytes=4096):
+    """Newest parseable JSON record of an append-only file, reading
+    only the final ``tail_bytes`` — heartbeat sidecars grow by one line
+    per chunk and only the last beat matters, so a full parse would
+    make liveness checks O(survey length) each. A torn final line (or
+    a first line truncated by the tail window) is skipped."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - tail_bytes))
+            tail = f.read()
+    except OSError:
+        return None
+    for line in reversed([l for l in tail.split(b"\n") if l]):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
 def _peak_to_row(p):
     return [int(getattr(p, f)) if f in PEAK_INT_FIELDS
             else float(getattr(p, f)) for f in PEAK_FIELDS]
@@ -131,16 +161,18 @@ class SurveyJournal:
         })
 
     def record_chunk(self, chunk_id, files, dms, peaks, wire_digest=None,
-                     timings=None, attempts=1, dq=None):
+                     timings=None, attempts=1, dq=None, extra=None):
         """Journal one completed chunk. The peak rows are appended (and
         fsync'd) BEFORE the chunk record, so a chunk record always
         implies its peaks are durable. ``dq`` is the chunk's
         data-quality summary (masked samples / quarantined files) for
-        downstream provenance."""
+        downstream provenance; ``extra`` merges additional provenance
+        fields into the record (e.g. the multihost layer's degraded
+        ``scope``/``process`` markers)."""
         offset = self._peak_store_len()
         _append_lines(self.peaks_path, [_peak_to_row(p) for p in peaks])
         self._peak_rows = offset + len(peaks)
-        _append_line(self.journal_path, {
+        rec = {
             "kind": "chunk", "chunk_id": int(chunk_id),
             "files": [os.path.basename(f) for f in files],
             "dms": [float(d) for d in dms],
@@ -148,12 +180,38 @@ class SurveyJournal:
             "peaks_offset": offset, "peaks_count": len(peaks),
             "timings": timings or {}, "attempts": int(attempts),
             "dq": dq or {},
+        }
+        rec.update(extra or {})
+        _append_line(self.journal_path, rec)
+
+    def record_parked(self, chunk_id, reason, files=None):
+        """Journal one *parked* chunk: set aside by the circuit breaker
+        (or any exhausted-retry path running degraded) without a
+        completed record, so a later resume re-dispatches it. Purely
+        informational for resume — :meth:`completed_chunks` ignores it
+        — but it makes the degraded run auditable."""
+        _append_line(self.journal_path, {
+            "kind": "parked", "chunk_id": int(chunk_id),
+            "reason": str(reason),
+            "files": [os.path.basename(f) for f in files or []],
         })
 
     def record_metrics(self, summary):
         """Append a metrics snapshot (see MetricsRegistry.summary)."""
         _append_line(self.journal_path, {"kind": "metrics",
                                          "summary": summary})
+
+    def heartbeat(self, process_index, ts=None):
+        """Append one liveness beat to THIS process's sidecar
+        (``heartbeat_<p>.jsonl``). Sidecars are single-writer by
+        construction; readers (:meth:`read_heartbeats`) scan them all."""
+        import time
+
+        p = int(process_index)
+        _append_line(
+            os.path.join(self.directory, f"heartbeat_{p:04d}.jsonl"),
+            {"process": p, "ts": float(ts if ts is not None else time.time())},
+        )
 
     # -- reading ------------------------------------------------------------
 
@@ -174,6 +232,33 @@ class SurveyJournal:
     def survey_id(self):
         hdr = self._header()
         return hdr.get("survey_id") if hdr else None
+
+    def parked_chunks(self):
+        """``{chunk_id: parked record}`` for chunks that were parked and
+        never subsequently completed (a chunk that later succeeded —
+        e.g. a half-open probe after a resume — is not parked)."""
+        done = self.completed_chunks()
+        out = {}
+        for rec in self._records():
+            if rec.get("kind") == "parked" \
+                    and int(rec["chunk_id"]) not in done:
+                out[int(rec["chunk_id"])] = rec
+        return out
+
+    def read_heartbeats(self):
+        """``{process_index: newest heartbeat timestamp}`` across every
+        ``heartbeat_*.jsonl`` sidecar in the journal directory (only
+        each file's tail is read — sidecars are append-only and only
+        the last beat matters)."""
+        import glob
+
+        out = {}
+        pattern = os.path.join(self.directory, "heartbeat_*.jsonl")
+        for path in glob.glob(pattern):
+            rec = _read_last_record(path)
+            if isinstance(rec, dict) and "ts" in rec:
+                out[int(rec.get("process", -1))] = float(rec["ts"])
+        return out
 
     def last_metrics(self):
         """Most recent journaled metrics summary, or None."""

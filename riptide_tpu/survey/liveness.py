@@ -1,0 +1,472 @@
+"""
+Liveness layer: deadline-driven hang detection for survey chunks and
+bounded waits around multi-host collectives.
+
+A survey that merely retries cannot tell a *hung* work unit from a slow
+one: a wedged device dispatch (or a dead peer behind a collective)
+blocks forever and no amount of backoff helps. This module supplies the
+wall-clock primitives the scheduler and the multi-host exchange build
+on:
+
+* :class:`Deadline` — a wall-clock budget with an explicit ``expire()``
+  so an abandoned attempt can observe that its result is no longer
+  wanted and stop short of dispatching real work;
+* :class:`DurationEWMA` + :class:`ChunkWatchdog` — an online
+  exponentially-weighted moving average of per-chunk durations; the
+  watchdog runs each dispatch on a sacrificial thread with budget
+  ``clamp(k * EWMA, floor_s, cap_s)`` and raises a *retryable*
+  :class:`ChunkTimeout` when it blows through it. Until the EWMA is
+  primed the budget is ``initial_s`` (None = no deadline for the first
+  chunks, which typically pay one-off compilation costs);
+* :func:`bounded_wait` — run any blocking callable with a timeout,
+  raising :class:`PeerTimeout`; :func:`bounded_allgather` and
+  :func:`barrier_with_timeout` apply it to the ``multihost_utils``
+  collectives (the ONLY call sites allowed to touch ``multihost_utils``
+  directly — enforced by ``tools/check_liveness_guards.py``);
+* :class:`PeerLivenessMonitor` — peer-loss detection over the journal's
+  per-process heartbeat sidecars, with journal-writer failover to the
+  lowest alive process and re-enqueue of a lost shard's unfinished
+  chunks.
+
+Python cannot kill a thread, so a timed-out attempt's thread is
+*abandoned*: it is a daemon, its :class:`Deadline` is expired, and the
+dispatch path re-checks the deadline after every fault-injection sleep
+so an abandoned attempt aborts before shipping real device work.
+"""
+import logging
+import threading
+import time
+
+from .metrics import get_metrics
+
+log = logging.getLogger("riptide_tpu.survey.liveness")
+
+__all__ = [
+    "ChunkTimeout", "PeerTimeout", "Deadline", "DurationEWMA",
+    "ChunkWatchdog", "bounded_wait", "bounded_allgather",
+    "barrier_with_timeout", "PeerLivenessMonitor", "is_timeout_error",
+]
+
+# Substrings identifying a deadline/hang condition in an exception
+# message: the watchdog's ChunkTimeout carries "deadline exceeded", and
+# a wedged real device surfaces as XlaRuntimeError DEADLINE_EXCEEDED.
+_TIMEOUT_MARKERS = ("deadline_exceeded", "deadline exceeded",
+                    "chunk timeout")
+
+
+def is_timeout_error(err):
+    """True when an exception looks like a hang/deadline condition (the
+    watchdog's :class:`ChunkTimeout`, or ``XlaRuntimeError:
+    DEADLINE_EXCEEDED ...`` from a wedged device). Timeouts are
+    retryable — the work may simply have landed on a wedged queue — but
+    are counted separately (``chunks_timed_out``) from generic retries
+    so a survey's hang rate is observable."""
+    if isinstance(err, ChunkTimeout):
+        return True
+    msg = str(err).lower()
+    return any(marker in msg for marker in _TIMEOUT_MARKERS)
+
+
+class ChunkTimeout(RuntimeError):
+    """A chunk dispatch exceeded its watchdog deadline. Retryable: the
+    attempt is abandoned and the chunk re-dispatched."""
+
+    retryable = True
+
+    def __init__(self, chunk_id, budget_s):
+        super().__init__(
+            f"chunk {chunk_id}: dispatch deadline exceeded "
+            f"({budget_s:.2f}s watchdog budget); abandoning the attempt"
+        )
+        self.chunk_id = chunk_id
+        self.budget_s = budget_s
+
+
+class PeerTimeout(RuntimeError):
+    """A bounded wait on a multi-host collective (or any blocking call)
+    expired — the usual cause is a dead or wedged peer process."""
+
+
+class Deadline:
+    """Wall-clock budget handed to an in-flight dispatch attempt.
+
+    ``expired`` becomes True either when the budget elapses or when the
+    watchdog explicitly calls :meth:`expire` after abandoning the
+    attempt; :meth:`check` raises :class:`ChunkTimeout` so an abandoned
+    thread stops before dispatching real work.
+    """
+
+    def __init__(self, budget_s, chunk_id=0, clock=time.monotonic):
+        self.budget_s = float(budget_s)
+        self.chunk_id = chunk_id
+        self._clock = clock
+        self._t0 = clock()
+        self._expired = threading.Event()
+
+    @property
+    def elapsed(self):
+        return self._clock() - self._t0
+
+    @property
+    def remaining(self):
+        return self.budget_s - self.elapsed
+
+    @property
+    def expired(self):
+        return self._expired.is_set() or self.remaining <= 0.0
+
+    def expire(self):
+        """Mark the deadline blown (called by the watchdog when it
+        abandons the attempt)."""
+        self._expired.set()
+
+    def check(self):
+        """Raise :class:`ChunkTimeout` if the deadline has passed."""
+        if self.expired:
+            raise ChunkTimeout(self.chunk_id, self.budget_s)
+
+
+class DurationEWMA:
+    """Online exponentially-weighted moving average of durations
+    (seconds). Thread-safe: the batcher's stream path and the
+    scheduler's watchdog may observe concurrently."""
+
+    def __init__(self, alpha=0.3):
+        self.alpha = float(alpha)
+        self._lock = threading.Lock()
+        self._value = None
+        self._count = 0
+
+    def observe(self, seconds):
+        with self._lock:
+            s = float(seconds)
+            self._value = (s if self._value is None
+                           else self.alpha * s
+                           + (1.0 - self.alpha) * self._value)
+            self._count += 1
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+
+class ChunkWatchdog:
+    """Run chunk dispatches under an adaptive wall-clock deadline.
+
+    The budget for the next dispatch is ``clamp(k * EWMA(chunk
+    durations), floor_s, cap_s)``; until the EWMA holds at least one
+    sample it is ``initial_s`` (None = unbounded, the safe default
+    while the first chunks pay compilation costs). A dispatch that
+    exceeds its budget is abandoned — its daemon thread's
+    :class:`Deadline` is expired so it aborts at the next check — and
+    :class:`ChunkTimeout` (retryable) is raised to the caller.
+
+    Parameters
+    ----------
+    k : float
+        Budget multiplier over the EWMA (headroom for stragglers).
+    floor_s, cap_s : float
+        Clamp bounds on the computed budget.
+    alpha : float
+        EWMA smoothing factor (weight of the newest sample).
+    initial_s : float or None
+        Budget before the EWMA is primed; None disables the deadline
+        for un-primed dispatches.
+    """
+
+    def __init__(self, k=4.0, floor_s=5.0, cap_s=900.0, alpha=0.3,
+                 initial_s=None, clock=time.monotonic):
+        if k <= 0 or floor_s <= 0 or cap_s < floor_s:
+            raise ValueError(
+                f"bad watchdog parameters: need k > 0, floor_s > 0, "
+                f"cap_s >= floor_s (got k={k}, floor_s={floor_s}, "
+                f"cap_s={cap_s})"
+            )
+        self.k = float(k)
+        self.floor_s = float(floor_s)
+        self.cap_s = float(cap_s)
+        self.initial_s = None if initial_s is None else float(initial_s)
+        self.ewma = DurationEWMA(alpha=alpha)
+        self._clock = clock
+        # Consecutive timed-out attempts: timeouts never feed the EWMA
+        # (an abandoned attempt has no true duration), so the budget
+        # escalates 2x per consecutive timeout instead — a workload
+        # that genuinely slowed down converges to a workable budget
+        # rather than timing out every chunk until the breaker parks
+        # the whole survey. Reset by any successful dispatch.
+        self._timeouts = 0
+
+    def observe(self, seconds):
+        """Feed one chunk duration into the EWMA (also called by the
+        batcher's non-journaled stream path, so a later journaled run
+        starts with primed budgets)."""
+        self.ewma.observe(seconds)
+
+    def budget(self):
+        """Wall-clock budget (seconds) for the next dispatch, or None
+        when no deadline applies yet. Escalates 2x per consecutive
+        timed-out attempt (capped at ``cap_s``) so a genuine workload
+        slowdown can re-converge instead of dying at a stale budget."""
+        mean = self.ewma.value
+        if mean is None:
+            base = self.initial_s
+        else:
+            base = min(self.cap_s, max(self.floor_s, self.k * mean))
+        if base is None:
+            return None
+        return min(self.cap_s, base * (2.0 ** self._timeouts))
+
+    def run(self, fn, chunk_id=0):
+        """Execute ``fn(deadline)`` under the current budget.
+
+        Returns ``fn``'s result and feeds the measured duration into
+        the EWMA; raises :class:`ChunkTimeout` after expiring the
+        deadline when the budget elapses first. ``fn`` receives the
+        :class:`Deadline` (or None when unbounded) and should re-check
+        it after any internal blocking so an abandoned attempt stops
+        early.
+        """
+        budget = self.budget()
+        t0 = self._clock()
+        if budget is None:
+            result = fn(None)
+            self._timeouts = 0
+            self.observe(self._clock() - t0)
+            return result
+
+        deadline = Deadline(budget, chunk_id=chunk_id, clock=self._clock)
+        completed, box = _run_sacrificial(
+            lambda: fn(deadline), budget, f"chunk-{chunk_id}-dispatch",
+        )
+        if not completed:
+            deadline.expire()
+            self._timeouts += 1
+            log.warning(
+                "watchdog: chunk %s dispatch exceeded its %.2fs budget "
+                "(EWMA %.3fs over %d chunks, %d consecutive timeouts); "
+                "abandoning the attempt",
+                chunk_id, budget, self.ewma.value or float("nan"),
+                self.ewma.count, self._timeouts,
+            )
+            raise ChunkTimeout(chunk_id, budget)
+        if "error" in box:
+            raise box["error"]
+        self._timeouts = 0
+        self.observe(self._clock() - t0)
+        return box["result"]
+
+
+def _run_sacrificial(fn, timeout_s, name):
+    """Run ``fn()`` on a sacrificial daemon thread, waiting at most
+    ``timeout_s`` seconds. Returns ``(completed, box)`` where ``box``
+    holds ``result`` or ``error`` when completed; on timeout the thread
+    is simply abandoned (Python cannot kill it). Shared by
+    :func:`bounded_wait` and :meth:`ChunkWatchdog.run` so the subtle
+    relay semantics (result box, BaseException capture, done event)
+    live in one place."""
+    box = {}
+    done = threading.Event()
+
+    def attempt():
+        try:
+            box["result"] = fn()
+        except BaseException as err:  # noqa: BLE001 - relayed by callers
+            box["error"] = err
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=attempt, daemon=True, name=name)
+    worker.start()
+    return done.wait(float(timeout_s)), box
+
+
+def bounded_wait(fn, timeout_s, what="blocking call"):
+    """Run ``fn()`` with a wall-clock bound.
+
+    ``timeout_s=None`` calls ``fn`` inline (unbounded). Otherwise ``fn``
+    runs on a sacrificial daemon thread; if it has not returned within
+    ``timeout_s`` seconds a :class:`PeerTimeout` is raised and the
+    thread is abandoned — for a ``multihost_utils`` collective that
+    means a dead/wedged peer no longer deadlocks every process forever.
+    Exceptions from ``fn`` propagate unchanged.
+    """
+    if timeout_s is None:
+        return fn()
+    completed, box = _run_sacrificial(fn, timeout_s, f"bounded-{what}")
+    if not completed:
+        raise PeerTimeout(
+            f"{what} did not complete within {timeout_s:.1f}s "
+            "(dead or straggling peer?)"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
+def bounded_allgather(arr, timeout_s=None, what="process_allgather"):
+    """``multihost_utils.process_allgather`` under :func:`bounded_wait`.
+
+    This function (with :func:`barrier_with_timeout`) is the only place
+    in the tree allowed to invoke a ``multihost_utils`` collective —
+    ``tools/check_liveness_guards.py`` enforces it — so every
+    cross-process wait in the survey path is bounded by construction.
+    """
+    from jax.experimental import multihost_utils
+
+    return bounded_wait(
+        lambda: multihost_utils.process_allgather(arr), timeout_s,
+        what=what,
+    )
+
+
+def barrier_with_timeout(tag, timeout_s=None):
+    """``multihost_utils.sync_global_devices(tag)`` under
+    :func:`bounded_wait`: a cross-process barrier that raises
+    :class:`PeerTimeout` instead of hanging forever on a dead peer."""
+    from jax.experimental import multihost_utils
+
+    return bounded_wait(
+        lambda: multihost_utils.sync_global_devices(tag), timeout_s,
+        what=f"barrier:{tag}",
+    )
+
+
+class PeerLivenessMonitor:
+    """Peer-loss detection over the journal's heartbeat sidecars.
+
+    Every process appends heartbeat records to its own sidecar file in
+    the shared journal directory (:meth:`SurveyJournal.heartbeat`); a
+    peer whose newest heartbeat is older than ``max_age_s`` is treated
+    as lost. The monitor answers the three survivor-side questions:
+    who is alive, who writes the journal (the lowest alive process —
+    failover from process 0), and which chunks of a lost shard must be
+    re-enqueued (journaled-complete chunks are never redone).
+
+    Parameters
+    ----------
+    journal : SurveyJournal
+        Shared journal (its directory holds the heartbeat sidecars).
+    process_index, process_count : int
+        This process's identity in the distributed runtime.
+    max_age_s : float
+        Heartbeat age beyond which a peer counts as lost.
+    """
+
+    def __init__(self, journal, process_index, process_count,
+                 max_age_s=60.0, clock=time.time, metrics=None):
+        self.journal = journal
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.max_age_s = float(max_age_s)
+        self._clock = clock
+        self._t0 = clock()  # start of the never-beat grace window
+        self.metrics = metrics or get_metrics()
+        self._beater_stop = None
+
+    def beat(self):
+        """Append one heartbeat for this process (call at least once
+        per chunk)."""
+        self.journal.heartbeat(self.process_index, ts=self._clock())
+
+    def start_beating(self, interval_s=None):
+        """Heartbeat from a background daemon thread every
+        ``interval_s`` seconds (default ``max_age_s / 3``).
+
+        Per-chunk :meth:`beat` calls alone make liveness track chunk
+        *progress*: a healthy process on one slow chunk would go stale
+        and another survivor could claim the journal-writer role while
+        the original writer still holds it (two writers on one
+        journal). A background beater decouples liveness from progress
+        — only a process that is actually dead, or wedged so hard the
+        interpreter makes no progress, stops beating. Idempotent; call
+        :meth:`stop_beating` (or exit the process) to stop."""
+        if self._beater_stop is not None:
+            return
+        stop = threading.Event()
+        interval = float(interval_s if interval_s is not None
+                         else self.max_age_s / 3.0)
+
+        def beater():
+            while not stop.wait(interval):
+                try:
+                    self.beat()
+                except OSError as err:  # pragma: no cover - disk loss
+                    log.warning("heartbeat append failed: %s", err)
+
+        self.beat()
+        threading.Thread(target=beater, daemon=True,
+                         name=f"heartbeat-{self.process_index}").start()
+        self._beater_stop = stop
+
+    def stop_beating(self):
+        """Stop the background heartbeat thread (tests/shutdown)."""
+        if self._beater_stop is not None:
+            self._beater_stop.set()
+            self._beater_stop = None
+
+    def peer_ages(self):
+        """``{process_index: seconds since its newest heartbeat}`` for
+        every process that has ever heartbeat. Also publishes the
+        ``heartbeat_age_s`` gauge (max age over the *other* processes,
+        0 when alone) so a survey's liveness is observable."""
+        now = self._clock()
+        ages = {p: max(0.0, now - ts)
+                for p, ts in self.journal.read_heartbeats().items()}
+        others = [a for p, a in ages.items() if p != self.process_index]
+        self.metrics.set_gauge("heartbeat_age_s",
+                               round(max(others), 3) if others else 0.0)
+        return ages
+
+    def alive(self):
+        """Sorted process indices currently considered alive. This
+        process always counts; a peer counts while its newest heartbeat
+        is younger than ``max_age_s``. A peer that never heartbeat is
+        presumed initialising — but only within a ``max_age_s`` grace
+        window from this monitor's construction: past that, no beat IS
+        the loss signal (a process that crashed during startup must not
+        hold the journal-writer role forever)."""
+        ages = self.peer_ages()
+        in_grace = self._clock() - self._t0 <= self.max_age_s
+        live = {self.process_index}
+        for p in range(self.process_count):
+            if p == self.process_index:
+                continue
+            age = ages.get(p)
+            if (age is None and in_grace) or \
+                    (age is not None and age <= self.max_age_s):
+                live.add(p)
+        return sorted(live)
+
+    def lost(self):
+        """Sorted process indices whose heartbeats have gone stale."""
+        return sorted(set(range(self.process_count)) - set(self.alive()))
+
+    def journal_writer(self):
+        """The process that writes shared journal records: the lowest
+        alive process (process 0 until it dies, then failover)."""
+        return self.alive()[0]
+
+    def unfinished_chunks(self, chunks_total):
+        """Chunk ids (of ``chunks_total``) with no completed journal
+        record — a lost shard's work, for survivors to re-enqueue."""
+        done = set(self.journal.completed_chunks())
+        return [c for c in range(int(chunks_total)) if c not in done]
+
+    def partial_chunks(self):
+        """Chunk ids whose newest journal record is degraded
+        (``scope: local`` — it holds only the writer's shard). These
+        count as *completed* for resume purposes, but in layouts where
+        one chunk id spans several processes' shards, the other
+        shards' peaks are absent: the survey driver decides whether to
+        re-search them (shard-per-process layouts with distinct chunk
+        ids per shard — the scheduler's layout — never need to)."""
+        return sorted(
+            cid for cid, (rec, _) in self.journal.completed_chunks().items()
+            if rec.get("scope") == "local"
+        )

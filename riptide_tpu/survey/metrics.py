@@ -31,7 +31,17 @@ contains whatever was recorded):
 ``files_salvaged``        counter: malformed files read as a prefix (policy)
 ``files_skipped``         counter: malformed files dropped (policy)
 ``oom_bisections``        counter: DM-batch halvings after device OOM
+``chunks_timed_out``      counter: dispatch attempts abandoned by the watchdog
+``breaker_opens``         counter: circuit-breaker closed/half-open -> open
+``chunks_parked``         counter: chunks set aside by the open breaker
+``peer_losses``           counter: collectives degraded to local-only mode
+``heartbeat_age_s``       gauge: age of the stalest peer heartbeat
 ========================  ====================================================
+
+The liveness counters (``chunks_timed_out`` .. ``peer_losses``) are
+always present in :meth:`summary` (zero when nothing fired) so survey
+health dashboards and the bench JSON sub-metrics block have a stable
+schema.
 
 Derived rates (e.g. ``wire_MBps``, ``dq_masked_frac``) are computed by
 :meth:`summary`, not stored.
@@ -118,6 +128,11 @@ class MetricsRegistry:
             out["dq_masked_frac"] = round(
                 out.get("dq_masked_samples", 0) / scanned, 6
             )
+        # Survey-health counters keep a stable schema: always present,
+        # zero when the corresponding machinery never fired.
+        for name in ("chunks_timed_out", "breaker_opens", "chunks_parked",
+                     "peer_losses"):
+            out.setdefault(name, 0)
         return out
 
     def reset(self):

@@ -18,7 +18,15 @@ collect) in a resumable queue:
   durable checkpoint after every chunk);
 * completed chunks append to the journal (peaks first, then the chunk
   record — both fsync'd) so a kill at any instant loses at most the
-  in-flight chunk.
+  in-flight chunk;
+* with a :class:`~riptide_tpu.survey.liveness.ChunkWatchdog`, each
+  dispatch attempt runs under an adaptive wall-clock deadline (budget =
+  k x EWMA of chunk durations) so a *hung* attempt is abandoned and
+  retried instead of stalling the survey forever;
+* with a :class:`CircuitBreaker`, a persistently failing target stops
+  burning retries: its chunks are *parked* (journaled, skipped,
+  re-dispatched by a later resume) and the survey completes degraded
+  rather than aborting.
 
 Fault injection (:mod:`riptide_tpu.survey.faults`) hooks the dispatch
 path so all of the above is testable on the CPU backend.
@@ -31,12 +39,13 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 from .faults import FaultAbort, FaultPlan
+from .liveness import is_timeout_error
 from .metrics import get_metrics
 
 log = logging.getLogger("riptide_tpu.survey.scheduler")
 
-__all__ = ["SurveyScheduler", "RetryPolicy", "TransientChunkError",
-           "survey_identity", "run_with_retry"]
+__all__ = ["SurveyScheduler", "RetryPolicy", "CircuitBreaker",
+           "TransientChunkError", "survey_identity", "run_with_retry"]
 
 
 class TransientChunkError(RuntimeError):
@@ -50,17 +59,24 @@ class RetryPolicy:
     Delay before retry ``k`` (0-based) is ``min(cap_s, base_s * 2**k)``
     scaled by a uniform jitter in ``[1 - jitter, 1 + jitter]`` — jitter
     decorrelates retry storms when many hosts share a flaky
-    interconnect. ``sleep``/``rng`` are injectable for tests.
+    interconnect. ``deadline_s`` is a TOTAL wall-clock budget for one
+    work unit's retry loop: attempts plus backoff can never exceed it
+    (a retry whose backoff would overrun the budget re-raises instead),
+    so a chunk that keeps timing out cannot stall the survey
+    open-endedly. ``sleep``/``rng``/``clock`` are injectable for tests.
     """
 
     def __init__(self, max_retries=3, base_s=0.25, cap_s=8.0, jitter=0.5,
-                 sleep=time.sleep, rng=None):
+                 deadline_s=None, sleep=time.sleep, rng=None,
+                 clock=time.monotonic):
         self.max_retries = int(max_retries)
         self.base_s = float(base_s)
         self.cap_s = float(cap_s)
         self.jitter = float(jitter)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
         self._sleep = sleep
         self._rng = rng or random.Random()
+        self._clock = clock
 
     def delay(self, attempt):
         """Backoff delay in seconds before retry ``attempt`` (0-based)."""
@@ -93,16 +109,27 @@ def run_with_retry(work, chunk_id, retry, faults, metrics, on_retry=None):
     dispatch trigger, runs ``work()``, and on a retryable failure backs
     off, bumps ``chunks_retried``, calls ``on_retry`` (recovery hook,
     e.g. re-preparing a corrupted buffer) and tries again.
-    :class:`FaultAbort` and exhausted retries propagate. Returns
-    ``(result, attempts)``."""
+    ``KeyboardInterrupt``/``SystemExit`` re-raise immediately — an
+    operator interrupt must never be "retried" or slept through — as do
+    :class:`FaultAbort` and exhausted retries. Watchdog/device timeouts
+    are counted as ``chunks_timed_out`` before retrying, and the whole
+    loop respects ``retry.deadline_s`` (attempts + backoff never exceed
+    the budget). Returns ``(result, attempts)``."""
     attempt = 0
+    t0 = retry._clock()
     while True:
         try:
             faults.before_dispatch(chunk_id)
             return work(), attempt + 1
+        except (KeyboardInterrupt, SystemExit):
+            raise
         except FaultAbort:
             raise
         except Exception as err:
+            if is_timeout_error(err):
+                # Hang rate is a first-class survey health signal,
+                # tracked apart from generic transient retries.
+                metrics.add("chunks_timed_out")
             if not getattr(err, "retryable", True):
                 # e.g. QuarantinedSeries: re-dispatching cannot fix the
                 # data, so propagate instead of burning retries.
@@ -111,8 +138,17 @@ def run_with_retry(work, chunk_id, retry, faults, metrics, on_retry=None):
                 log.error("chunk %d failed after %d attempts: %s",
                           chunk_id, attempt + 1, err)
                 raise
-            metrics.add("chunks_retried")
             delay = retry.delay(attempt)
+            if retry.deadline_s is not None:
+                elapsed = retry._clock() - t0
+                if elapsed + delay > retry.deadline_s:
+                    log.error(
+                        "chunk %d: retry budget exhausted (%.2fs elapsed "
+                        "+ %.2fs backoff > %.2fs deadline); giving up: %s",
+                        chunk_id, elapsed, delay, retry.deadline_s, err,
+                    )
+                    raise
+            metrics.add("chunks_retried")
             log.warning(
                 "chunk %d dispatch failed (%s); retry %d/%d in %.2fs",
                 chunk_id, err, attempt + 1, retry.max_retries, delay,
@@ -121,6 +157,91 @@ def run_with_retry(work, chunk_id, retry, faults, metrics, on_retry=None):
             if on_retry is not None:
                 on_retry()
             attempt += 1
+
+
+class CircuitBreaker:
+    """Per-target circuit breaker over chunk dispatch outcomes.
+
+    Retry/backoff handles *transient* faults; a shard or device that
+    fails every attempt would still burn the full retry budget on every
+    subsequent chunk. The breaker cuts that loss: ``failure_threshold``
+    consecutive chunk failures open the circuit (``breaker_opens``
+    metric), and while open every arriving chunk is *parked* — journaled
+    as a ``parked`` record, skipped, survey continues — without touching
+    the device. After ``cooldown_s`` the breaker goes half-open and
+    admits ONE probe chunk: success closes the circuit, failure re-opens
+    it and restarts the cooldown.
+
+    States: ``closed`` (normal) -> ``open`` (parking) -> ``half-open``
+    (one probe in flight) -> closed/open.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(self, failure_threshold=3, cooldown_s=60.0,
+                 clock=time.monotonic, metrics=None):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._state = self.CLOSED
+        self._failures = 0       # consecutive failures while closed
+        self._opened_at = None
+        # None = unbound: the owning scheduler adopts the breaker into
+        # its own registry, so breaker_opens lands next to chunks_parked
+        # even with a non-default registry.
+        self.metrics = metrics
+
+    @property
+    def state(self):
+        if self._state == self.OPEN and self._opened_at is not None \
+                and self._clock() - self._opened_at >= self.cooldown_s:
+            return self.HALF_OPEN
+        return self._state
+
+    def allow(self):
+        """May the next chunk dispatch? While open (cooldown running)
+        the answer is no; once the cooldown elapses the breaker turns
+        half-open and admits a single probe."""
+        state = self.state
+        if state == self.CLOSED:
+            return True
+        if state == self.HALF_OPEN:
+            # Admit the probe. Dispatch is sequential, so the probe's
+            # outcome is recorded before the next allow() call.
+            self._state = self.HALF_OPEN
+            self._opened_at = None
+            return True
+        return False
+
+    def record_success(self):
+        if self._state == self.HALF_OPEN:
+            log.info("circuit breaker: probe chunk succeeded; closing")
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = None
+
+    def record_failure(self):
+        if self._state == self.HALF_OPEN:
+            log.warning("circuit breaker: probe chunk failed; re-opening "
+                        "for %.1fs", self.cooldown_s)
+            self._open()
+            return
+        self._failures += 1
+        if self._failures >= self.failure_threshold:
+            log.warning(
+                "circuit breaker: %d consecutive chunk failures; opening "
+                "for %.1fs (chunks will be parked, not retried)",
+                self._failures, self.cooldown_s,
+            )
+            self._open()
+
+    def _open(self):
+        self._state = self.OPEN
+        self._failures = 0
+        self._opened_at = self._clock()
+        (self.metrics or get_metrics()).add("breaker_opens")
 
 
 def _wire_digest(items):
@@ -162,10 +283,24 @@ class SurveyScheduler:
         of the chunk filenames.
     metrics : MetricsRegistry or None
         Defaults to the process-wide registry.
+    watchdog : ChunkWatchdog or None
+        When given, every dispatch attempt runs under its adaptive
+        wall-clock deadline: a hung attempt is abandoned, raises a
+        retryable ChunkTimeout, and is re-dispatched.
+    breaker : CircuitBreaker or None
+        When given, a chunk whose retries are exhausted is *parked*
+        (journaled as a ``parked`` record, survey continues) instead of
+        aborting the run, and consecutive failures open the circuit so
+        further chunks park without burning retry budget. Without a
+        breaker, exhausted retries propagate (legacy behaviour).
+    monitor : PeerLivenessMonitor or None
+        When given, a heartbeat is appended to this process's journal
+        sidecar as each chunk starts (multi-host peer-loss detection).
     """
 
     def __init__(self, searcher, chunks, journal=None, resume=False,
-                 retry=None, faults=None, survey_id=None, metrics=None):
+                 retry=None, faults=None, survey_id=None, metrics=None,
+                 watchdog=None, breaker=None, monitor=None):
         self.searcher = searcher
         self.chunks = [list(c) for c in chunks]
         self.journal = journal
@@ -173,6 +308,11 @@ class SurveyScheduler:
         self.retry = retry or RetryPolicy()
         self.faults = faults or FaultPlan()
         self.metrics = metrics or get_metrics()
+        self.watchdog = watchdog
+        self.breaker = breaker
+        if breaker is not None and breaker.metrics is None:
+            breaker.metrics = self.metrics
+        self.monitor = monitor
         if survey_id is None:
             survey_id = survey_identity([f for c in self.chunks for f in c])
         self.survey_id = survey_id
@@ -200,9 +340,15 @@ class SurveyScheduler:
 
     # -- dispatch -----------------------------------------------------------
 
-    def _dispatch_once(self, chunk_id, items, digest):
+    def _dispatch_once(self, chunk_id, items, digest, deadline=None):
         """One dispatch attempt: digest check, ship, queue, collect.
-        (The fault plan's dispatch trigger fires in run_with_retry.)"""
+        (The fault plan's dispatch trigger fires in run_with_retry;
+        hang/straggle faults fire here, inside the watchdog deadline.)
+        An attempt the watchdog already abandoned aborts at the
+        deadline check instead of shipping real device work."""
+        self.faults.in_flight(chunk_id)
+        if deadline is not None:
+            deadline.check()
         if digest is not None and _wire_digest(items) != digest:
             raise TransientChunkError(
                 f"chunk {chunk_id}: prepared wire buffer digest mismatch "
@@ -220,6 +366,14 @@ class SurveyScheduler:
         state = {"items": items, "digest": digest}
 
         def work():
+            if self.watchdog is not None:
+                return self.watchdog.run(
+                    lambda deadline: self._dispatch_once(
+                        chunk_id, state["items"], state["digest"],
+                        deadline=deadline,
+                    ),
+                    chunk_id,
+                )
             return self._dispatch_once(chunk_id, state["items"],
                                        state["digest"])
 
@@ -236,6 +390,18 @@ class SurveyScheduler:
             on_retry=recover,
         )
         return peaks, attempts, state["digest"]
+
+    # -- parking ------------------------------------------------------------
+
+    def _park(self, chunk_id, reason):
+        """Park one chunk: journal a ``parked`` record and skip it. A
+        parked chunk has NO completed record, so a later ``--resume``
+        run re-dispatches it once the underlying fault clears."""
+        log.warning("parking chunk %d: %s", chunk_id, reason)
+        self.metrics.add("chunks_parked")
+        if self.journal is not None:
+            self.journal.record_parked(chunk_id, reason,
+                                       files=self.chunks[chunk_id])
 
     # -- main loop ----------------------------------------------------------
 
@@ -284,11 +450,29 @@ class SurveyScheduler:
                         self._stage, loaders, self.chunks[pending[k + 1]],
                         pending[k + 1],
                     )
+                if self.monitor is not None:
+                    self.monitor.beat()
+                if self.breaker is not None and not self.breaker.allow():
+                    self._park(cid, f"circuit {self.breaker.state}")
+                    continue
                 t0 = time.perf_counter()
                 self.faults.corrupt_wire(cid, items)
-                peaks, attempts, digest = self._dispatch_with_retry(
-                    cid, tslist, items, digest
-                )
+                try:
+                    peaks, attempts, digest = self._dispatch_with_retry(
+                        cid, tslist, items, digest
+                    )
+                except (KeyboardInterrupt, SystemExit, FaultAbort):
+                    raise
+                except Exception as err:
+                    if self.breaker is None:
+                        raise
+                    # Breaker configured: a chunk that exhausted its
+                    # retries parks instead of aborting the survey.
+                    self.breaker.record_failure()
+                    self._park(cid, f"dispatch failed after retries: {err}")
+                    continue
+                if self.breaker is not None:
+                    self.breaker.record_success()
                 chunk_s = time.perf_counter() - t0
                 self.metrics.observe("chunk_s", chunk_s)
                 self.metrics.add("chunks_done")

@@ -1,0 +1,725 @@
+"""
+Liveness-layer tests: deadline/watchdog hang detection, retry deadline
+budgets, circuit breaking with parked chunks, bounded collective waits,
+heartbeat-based peer-loss detection and the degraded local-only mode of
+the multi-host exchange.
+
+Everything runs on the CPU backend; hangs and peer losses are injected
+(:mod:`riptide_tpu.survey.faults`) so the machinery is exercised
+end-to-end without real hardware faults. The acceptance paths: an
+injected ``hang`` is cancelled by the watchdog within its deadline,
+retried, and the survey completes with identical data products; a
+persistent failure opens the breaker and parks chunks without aborting
+the survey; an injected ``peer_loss`` degrades to local-only mode
+instead of deadlocking.
+"""
+import pytest
+
+from riptide_tpu.survey.faults import FaultPlan, InjectedFault, InjectedPeerLoss
+from riptide_tpu.survey.journal import SurveyJournal
+from riptide_tpu.survey.liveness import (
+    ChunkTimeout, ChunkWatchdog, Deadline, DurationEWMA,
+    PeerLivenessMonitor, PeerTimeout, bounded_wait, is_timeout_error,
+)
+from riptide_tpu.survey.metrics import MetricsRegistry, get_metrics
+from riptide_tpu.survey.scheduler import (
+    CircuitBreaker, RetryPolicy, SurveyScheduler, run_with_retry,
+)
+from riptide_tpu.peak_detection import Peak
+
+from synth import generate_data_presto
+
+TOBS = 16.0
+TSAMP = 1e-3
+PERIOD = 0.5
+
+
+def _peak(period=0.5, snr=10.0, dm=0.0):
+    return Peak(period=period, freq=1.0 / period, width=3, ducy=0.05,
+                iw=1, ip=7, snr=snr, dm=dm)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ------------------------------------------------------------- deadline
+
+def test_deadline_expiry_and_check():
+    clk = FakeClock()
+    d = Deadline(2.0, chunk_id=3, clock=clk)
+    assert not d.expired
+    assert d.remaining == 2.0
+    clk.advance(1.5)
+    d.check()  # still within budget
+    clk.advance(1.0)
+    assert d.expired
+    with pytest.raises(ChunkTimeout):
+        d.check()
+
+
+def test_deadline_explicit_expire():
+    d = Deadline(1e9, chunk_id=0, clock=FakeClock())
+    d.expire()
+    assert d.expired
+    with pytest.raises(ChunkTimeout):
+        d.check()
+
+
+def test_is_timeout_error_classification():
+    assert is_timeout_error(ChunkTimeout(0, 1.0))
+    assert is_timeout_error(RuntimeError("DEADLINE_EXCEEDED: queue wedged"))
+    assert not is_timeout_error(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    # The engine re-exports the helper next to is_oom_error.
+    from riptide_tpu.search.engine import is_timeout_error as from_engine
+
+    assert from_engine is is_timeout_error
+
+
+# ------------------------------------------------------- EWMA + watchdog
+
+def test_duration_ewma():
+    e = DurationEWMA(alpha=0.5)
+    assert e.value is None and e.count == 0
+    e.observe(1.0)
+    assert e.value == 1.0
+    e.observe(3.0)
+    assert e.value == 2.0  # 0.5*3 + 0.5*1
+    assert e.count == 2
+
+
+def test_watchdog_budget_clamps():
+    w = ChunkWatchdog(k=2.0, floor_s=1.0, cap_s=30.0, initial_s=7.0)
+    assert w.budget() == 7.0          # un-primed -> initial
+    w.ewma.observe(1.0)
+    assert w.budget() == 2.0          # k * EWMA
+    # cap_s bounds the un-primed budget too.
+    assert ChunkWatchdog(k=2.0, floor_s=1.0, cap_s=3.0,
+                         initial_s=7.0).budget() == 3.0
+    w2 = ChunkWatchdog(k=2.0, floor_s=1.0, cap_s=3.0)
+    assert w2.budget() is None        # un-primed, no initial -> unbounded
+    w2.ewma.observe(0.01)
+    assert w2.budget() == 1.0         # floor
+    w3 = ChunkWatchdog(k=2.0, floor_s=1.0, cap_s=3.0)
+    w3.ewma.observe(100.0)
+    assert w3.budget() == 3.0         # cap
+
+
+def test_watchdog_rejects_bad_params():
+    with pytest.raises(ValueError):
+        ChunkWatchdog(k=0.0)
+    with pytest.raises(ValueError):
+        ChunkWatchdog(floor_s=10.0, cap_s=1.0)
+
+
+def test_watchdog_runs_and_observes():
+    w = ChunkWatchdog(k=4.0, floor_s=5.0, cap_s=30.0)
+    assert w.run(lambda dl: 42, chunk_id=0) == 42   # unbounded first run
+    assert w.ewma.count == 1
+    assert w.run(lambda dl: dl.budget_s, chunk_id=1) > 0  # now bounded
+    assert w.ewma.count == 2
+
+
+def test_watchdog_abandons_hung_dispatch():
+    import time
+
+    w = ChunkWatchdog(k=2.0, floor_s=0.05, cap_s=0.1, initial_s=0.1)
+    seen = {}
+
+    def hung(deadline):
+        seen["deadline"] = deadline
+        time.sleep(2.0)
+        deadline.check()  # the abandoned thread must stop here
+        seen["dispatched"] = True  # pragma: no cover - must not happen
+
+    t0 = time.monotonic()
+    with pytest.raises(ChunkTimeout):
+        w.run(hung, chunk_id=9)
+    assert time.monotonic() - t0 < 1.0  # cancelled well before the sleep ends
+    assert seen["deadline"].expired
+    assert w.ewma.count == 0  # a timed-out attempt must not skew the EWMA
+
+
+def test_watchdog_budget_escalates_after_timeouts():
+    """Timeouts never feed the EWMA, so the budget must escalate per
+    consecutive timeout — a workload that genuinely slowed down
+    converges instead of timing out every chunk forever."""
+    import time
+
+    w = ChunkWatchdog(k=2.0, floor_s=0.05, cap_s=10.0, initial_s=0.05)
+    assert w.budget() == 0.05
+    with pytest.raises(ChunkTimeout):
+        w.run(lambda dl: time.sleep(1.0), chunk_id=0)
+    assert w.budget() == 0.1   # 2x after one timeout
+    with pytest.raises(ChunkTimeout):
+        w.run(lambda dl: time.sleep(1.0), chunk_id=0)
+    assert w.budget() == 0.2   # 4x after two
+    w.run(lambda dl: None, chunk_id=0)  # success resets the escalation
+    assert w.ewma.count == 1
+    assert w.budget() == 0.05  # floor'd k*EWMA, no escalation factor
+
+
+def test_watchdog_propagates_dispatch_errors():
+    w = ChunkWatchdog(initial_s=5.0)
+
+    def boom(deadline):
+        raise ValueError("no")
+
+    with pytest.raises(ValueError):
+        w.run(boom, chunk_id=0)
+
+
+# ---------------------------------------------------------- bounded_wait
+
+def test_bounded_wait_passthrough_and_timeout():
+    import time
+
+    assert bounded_wait(lambda: 5, None) == 5
+    assert bounded_wait(lambda: 5, 1.0) == 5
+    with pytest.raises(ValueError):
+        bounded_wait(lambda: (_ for _ in ()).throw(ValueError("x")), 1.0)
+    t0 = time.monotonic()
+    with pytest.raises(PeerTimeout):
+        bounded_wait(lambda: time.sleep(3.0), 0.05, what="test collective")
+    assert time.monotonic() - t0 < 1.0
+
+
+# ------------------------------------------------------- retry deadline
+
+def test_retry_deadline_budget_stops_retrying():
+    clk = FakeClock()
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        clk.advance(s)
+
+    retry = RetryPolicy(max_retries=10, base_s=1.0, cap_s=8.0, jitter=0.0,
+                        deadline_s=2.5, sleep=sleep, clock=clk)
+    m = MetricsRegistry()
+
+    def work():
+        raise InjectedFault("persistent")
+
+    with pytest.raises(InjectedFault):
+        run_with_retry(work, 0, retry, FaultPlan(), m)
+    # delay 1.0 fits the 2.5s budget, the next (2.0) would overrun it.
+    assert sleeps == [1.0]
+    assert m.counter("chunks_retried") == 1
+
+
+def test_retry_reraises_operator_interrupts_immediately():
+    sleeps = []
+    retry = RetryPolicy(max_retries=5, sleep=sleeps.append)
+    m = MetricsRegistry()
+
+    def interrupted():
+        raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        run_with_retry(interrupted, 0, retry, FaultPlan(), m)
+
+    def exiting():
+        raise SystemExit(1)
+
+    with pytest.raises(SystemExit):
+        run_with_retry(exiting, 0, retry, FaultPlan(), m)
+    assert sleeps == []  # never slept through an interrupt
+    assert m.counter("chunks_retried") == 0
+
+
+def test_retry_counts_timeouts():
+    retry = RetryPolicy(max_retries=5, sleep=lambda s: None)
+    m = MetricsRegistry()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ChunkTimeout(0, 0.5)
+        return "ok"
+
+    result, attempts = run_with_retry(flaky, 0, retry, FaultPlan(), m)
+    assert result == "ok" and attempts == 3
+    assert m.counter("chunks_timed_out") == 2
+    assert m.counter("chunks_retried") == 2
+
+
+# ------------------------------------------------------ circuit breaker
+
+def test_breaker_state_machine():
+    get_metrics().reset()
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=2, cooldown_s=10.0, clock=clk)
+    assert b.state == b.CLOSED and b.allow()
+    b.record_failure()
+    assert b.state == b.CLOSED and b.allow()  # below threshold
+    b.record_failure()
+    assert b.state == b.OPEN and not b.allow()
+    assert get_metrics().counter("breaker_opens") == 1
+    clk.advance(11.0)
+    assert b.state == b.HALF_OPEN
+    assert b.allow()                          # the probe chunk
+    b.record_success()
+    assert b.state == b.CLOSED and b.allow()
+
+
+def test_breaker_probe_failure_reopens():
+    get_metrics().reset()
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, clock=clk)
+    b.record_failure()
+    assert not b.allow()
+    clk.advance(6.0)
+    assert b.allow()          # half-open probe
+    b.record_failure()        # probe fails
+    assert b.state == b.OPEN and not b.allow()
+    assert get_metrics().counter("breaker_opens") == 2
+    # Success is also reachable from closed after intervening failures.
+    b.record_success()
+    assert b.state == b.CLOSED
+
+
+def test_breaker_rejects_bad_threshold():
+    with pytest.raises(ValueError):
+        CircuitBreaker(failure_threshold=0)
+
+
+# ------------------------------------------------------ new fault kinds
+
+def test_fault_plan_hang_straggle_peer_loss():
+    sleeps = []
+    plan = FaultPlan.parse("hang:2:5,straggle:1:0.5,peer_loss:3",
+                           sleep=sleeps.append)
+    plan.in_flight(0)                 # no directive
+    assert sleeps == []
+    plan.in_flight(2)                 # hang
+    assert sleeps == [5.0]
+    plan.in_flight(1)                 # straggle
+    assert sleeps == [5.0, 0.5]
+    plan.in_flight(2)                 # consumed
+    assert sleeps == [5.0, 0.5]
+    with pytest.raises(InjectedPeerLoss):
+        plan.before_gather(3)
+    plan.before_gather(3)             # consumed
+    # InjectedPeerLoss routes through the PeerTimeout handling.
+    assert issubclass(InjectedPeerLoss, PeerTimeout)
+
+
+# -------------------------------------------- journal: parked + beats
+
+def test_journal_parked_records(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("abc", 3)
+    j.record_parked(1, "circuit open", files=["/x/b.inf"])
+    parked = j.parked_chunks()
+    assert sorted(parked) == [1]
+    assert parked[1]["reason"] == "circuit open"
+    assert parked[1]["files"] == ["b.inf"]
+    # A later completed record supersedes the parked state.
+    j.record_chunk(1, ["b.inf"], [5.0], [_peak()])
+    assert j.parked_chunks() == {}
+    assert sorted(j.completed_chunks()) == [1]
+
+
+def test_journal_heartbeat_sidecars(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.heartbeat(0, ts=1.5)
+    j.heartbeat(0, ts=2.5)
+    j.heartbeat(1, ts=2.0)
+    assert j.read_heartbeats() == {0: 2.5, 1: 2.0}
+    # Sidecars are per-process files: no shared-file write contention.
+    names = sorted(p.name for p in (tmp_path / "j").glob("heartbeat_*"))
+    assert names == ["heartbeat_0000.jsonl", "heartbeat_0001.jsonl"]
+
+
+# ------------------------------------------------- peer liveness monitor
+
+def test_monitor_alive_lost_and_writer_failover(tmp_path):
+    m = MetricsRegistry()
+    j = SurveyJournal(tmp_path / "j")
+    j.heartbeat(0, ts=2.0)   # age 8 at now=10 -> lost
+    j.heartbeat(2, ts=7.0)   # age 3 -> alive
+    mon = PeerLivenessMonitor(j, process_index=1, process_count=3,
+                              max_age_s=5.0, clock=lambda: 10.0, metrics=m)
+    assert mon.alive() == [1, 2]
+    assert mon.lost() == [0]
+    assert mon.journal_writer() == 1  # failover: lowest ALIVE process
+    assert m.snapshot()["gauges"]["heartbeat_age_s"] == 8.0
+
+
+def test_monitor_unknown_peers_count_alive(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    mon = PeerLivenessMonitor(j, process_index=1, process_count=3,
+                              max_age_s=5.0, clock=lambda: 10.0,
+                              metrics=MetricsRegistry())
+    # No heartbeats at all: peers may still be initialising.
+    assert mon.alive() == [0, 1, 2]
+    assert mon.journal_writer() == 0
+
+
+def test_monitor_never_beat_peer_lost_after_grace(tmp_path):
+    """A peer that NEVER heartbeats counts alive only within the
+    max_age_s grace window from monitor construction: a process that
+    crashed during startup must not hold the writer role forever."""
+    clk = FakeClock(10.0)
+    j = SurveyJournal(tmp_path / "j")
+    mon = PeerLivenessMonitor(j, process_index=1, process_count=2,
+                              max_age_s=5.0, clock=clk,
+                              metrics=MetricsRegistry())
+    assert mon.alive() == [0, 1]      # within the grace window
+    clk.advance(6.0)
+    assert mon.alive() == [1]         # grace expired, still no beat
+    assert mon.lost() == [0]
+    assert mon.journal_writer() == 1  # failover despite zero beats
+
+
+def test_monitor_beat_and_unfinished_chunks(tmp_path):
+    clk = FakeClock(100.0)
+    j = SurveyJournal(tmp_path / "j")
+    mon = PeerLivenessMonitor(j, process_index=0, process_count=2,
+                              max_age_s=5.0, clock=clk,
+                              metrics=MetricsRegistry())
+    mon.beat()
+    assert j.read_heartbeats() == {0: 100.0}
+    j.record_chunk(1, ["b.inf"], [5.0], [])
+    assert mon.unfinished_chunks(3) == [0, 2]
+
+
+def test_monitor_background_beater(tmp_path):
+    """The background heartbeat thread keeps a slow-but-alive process
+    fresh independent of chunk progress (no per-chunk beat needed), so
+    it can never spuriously lose the journal-writer role."""
+    import time
+
+    j = SurveyJournal(tmp_path / "j")
+    mon = PeerLivenessMonitor(j, process_index=0, process_count=1,
+                              max_age_s=10.0, metrics=MetricsRegistry())
+    mon.start_beating(interval_s=0.05)
+    mon.start_beating(interval_s=0.05)  # idempotent
+    try:
+        time.sleep(0.25)
+    finally:
+        mon.stop_beating()
+    first = j.read_heartbeats()[0]
+    assert first > 0
+    # Beats kept flowing without any explicit beat() call.
+    with open(j.directory + "/heartbeat_0000.jsonl") as f:
+        assert len(f.readlines()) >= 3
+
+
+def test_monitor_partial_chunks(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.record_chunk(0, ["a.inf"], [0.0], [_peak()])
+    j.record_chunk(1, ["b.inf"], [5.0], [_peak()],
+                   extra={"scope": "local", "process": 1})
+    mon = PeerLivenessMonitor(j, process_index=1, process_count=2,
+                              max_age_s=5.0, metrics=MetricsRegistry())
+    assert mon.partial_chunks() == [1]
+    assert mon.unfinished_chunks(2) == []  # local records still complete
+
+
+# --------------------------------------- multihost degraded local mode
+
+@pytest.fixture
+def undegraded():
+    import riptide_tpu.parallel.multihost as mh
+
+    mh.reset_degraded()
+    yield mh
+    mh.reset_degraded()
+
+
+def test_gather_injected_peer_loss_degrades(monkeypatch, undegraded):
+    mh = undegraded
+    get_metrics().reset()
+    monkeypatch.setattr(mh.jax, "process_count", lambda: 2)
+    local = [_peak()]
+    out = mh.gather_peaks(local, faults=FaultPlan.parse("peer_loss:5"),
+                          chunk_id=5, timeout_s=1.0)
+    assert out == local
+    assert mh.is_degraded()
+    assert get_metrics().counter("peer_losses") == 1
+    # Sticky: later gathers skip the collectives entirely (no fault
+    # needed, no deadlock risk).
+    assert mh.gather_peaks(local, chunk_id=6, timeout_s=1.0) == local
+    assert get_metrics().counter("peer_losses") == 1
+
+
+def test_gather_collective_timeout_degrades(monkeypatch, undegraded):
+    mh = undegraded
+    get_metrics().reset()
+    monkeypatch.setattr(mh.jax, "process_count", lambda: 2)
+
+    def timed_out(arr, timeout_s, what):
+        raise PeerTimeout(f"{what} did not complete")
+
+    monkeypatch.setattr(mh, "_allgather", timed_out)
+    local = [_peak(), _peak(snr=8.0)]
+    assert mh.gather_peaks(local, timeout_s=0.1) == local
+    assert mh.is_degraded()
+    assert get_metrics().counter("peer_losses") == 1
+
+
+def test_init_distributed_noop_returns_zero():
+    from riptide_tpu.parallel.distributed import init_distributed
+
+    assert init_distributed() == 0  # truthiness-compatible no-op
+
+
+# ------------------------------------------------- config + CLI surface
+
+def test_rseek_parser_has_deadline_flag():
+    from riptide_tpu.apps.rseek import get_parser
+
+    args = get_parser().parse_args(
+        ["-f", "presto", "--deadline-s", "5", "x.inf"]
+    )
+    assert args.deadline_s == 5.0
+
+
+def test_liveness_config_validation():
+    import copy
+
+    from riptide_tpu.pipeline.config_validation import (
+        InvalidPipelineConfig, validate_pipeline_config,
+    )
+
+    base = _survey_config()
+    conf = copy.deepcopy(base)
+    conf["liveness"] = {"enabled": True, "watchdog_k": 3.0,
+                        "watchdog_floor_s": 0.5, "retry_deadline_s": None,
+                        "breaker_threshold": 2}
+    out = validate_pipeline_config(conf)
+    assert out["liveness"]["watchdog_k"] == 3.0
+    assert out["liveness"]["retry_deadline_s"] is None
+
+    bad = copy.deepcopy(base)
+    bad["liveness"] = {"watchdog_k": 0.5}  # must be > 1
+    with pytest.raises(InvalidPipelineConfig):
+        validate_pipeline_config(bad)
+    bad = copy.deepcopy(base)
+    bad["liveness"] = {"watchdgo_k": 3.0}  # typo'd key
+    with pytest.raises(InvalidPipelineConfig):
+        validate_pipeline_config(bad)
+
+
+def test_metrics_summary_exposes_liveness_counters():
+    s = MetricsRegistry().summary()
+    for name in ("chunks_timed_out", "breaker_opens", "chunks_parked",
+                 "peer_losses"):
+        assert s[name] == 0
+
+
+# ----------------------------------------------- scheduler end to end
+
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+
+def _searcher(**kwargs):
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+
+    return BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                         SEARCH_CONF, fmt="presto", io_threads=1, **kwargs)
+
+
+def _three_trials(tmp_path):
+    return [
+        generate_data_presto(str(tmp_path), f"t_DM{dm:.2f}", tobs=TOBS,
+                             tsamp=TSAMP, period=PERIOD, dm=dm,
+                             amplitude=amp, ducy=0.02)
+        for dm, amp in ((0.0, 15.0), (10.0, 40.0), (20.0, 15.0))
+    ]
+
+
+def _fast_retry(**kwargs):
+    return RetryPolicy(max_retries=3, base_s=0.01, cap_s=0.02,
+                       sleep=lambda s: None, **kwargs)
+
+
+def test_scheduler_watchdog_cancels_hang_and_retries(tmp_path):
+    """Acceptance: an injected hang on chunk 2 is abandoned by the
+    watchdog within its EWMA-derived deadline, the chunk is retried,
+    and the survey completes with the identical peak list."""
+    files = _three_trials(tmp_path)
+    chunks = [[f] for f in files]
+
+    get_metrics().reset()
+    expected = SurveyScheduler(_searcher(), chunks).run()  # warm + oracle
+
+    get_metrics().reset()
+    journal = SurveyJournal(tmp_path / "j")
+    watchdog = ChunkWatchdog(k=4.0, floor_s=0.5, cap_s=30.0)
+    sched = SurveyScheduler(
+        _searcher(), chunks, journal=journal, retry=_fast_retry(),
+        faults=FaultPlan.parse("hang:2:15"), watchdog=watchdog,
+    )
+    peaks = sched.run()
+    assert peaks == expected  # exact float equality: same peaks
+    assert get_metrics().counter("chunks_timed_out") >= 1
+    assert get_metrics().counter("chunks_retried") >= 1
+    done = journal.completed_chunks()
+    assert sorted(done) == [0, 1, 2]
+    assert done[2][0]["attempts"] >= 2
+    # The hang was cancelled at the deadline, not ridden out: the
+    # budget for chunk 2 was far below the 15s injected hang.
+    assert watchdog.budget() < 15.0
+
+
+def test_scheduler_straggler_survives_within_deadline(tmp_path):
+    """A straggling (slow but alive) chunk must NOT be killed while it
+    stays inside the watchdog budget, and its duration feeds the EWMA."""
+    files = _three_trials(tmp_path)
+    chunks = [[f] for f in files]
+
+    get_metrics().reset()
+    expected = SurveyScheduler(_searcher(), chunks).run()
+
+    get_metrics().reset()
+    watchdog = ChunkWatchdog(k=4.0, floor_s=10.0, cap_s=60.0)
+    sched = SurveyScheduler(
+        _searcher(), chunks, retry=_fast_retry(),
+        faults=FaultPlan.parse("straggle:1:0.3"), watchdog=watchdog,
+    )
+    peaks = sched.run()
+    assert peaks == expected
+    assert get_metrics().counter("chunks_timed_out") == 0
+    assert watchdog.ewma.count == 3
+
+
+def test_scheduler_breaker_parks_persistent_failure(tmp_path):
+    """Acceptance: the breaker opens after N consecutive failures and
+    parks chunks (journaled, survey completes) instead of aborting; a
+    later resume re-dispatches the parked chunks and converges on the
+    uninterrupted result."""
+    files = _three_trials(tmp_path)
+    chunks = [[f] for f in files]
+
+    get_metrics().reset()
+    expected = SurveyScheduler(_searcher(), chunks).run()
+
+    get_metrics().reset()
+    jdir = tmp_path / "j"
+    sched = SurveyScheduler(
+        _searcher(), chunks, journal=SurveyJournal(jdir),
+        retry=RetryPolicy(max_retries=1, sleep=lambda s: None),
+        faults=FaultPlan.parse("raise:1x50"),
+        breaker=CircuitBreaker(failure_threshold=1, cooldown_s=1e9),
+    )
+    peaks = sched.run()  # completes despite the persistent failure
+    # Chunk 1 failed and opened the circuit; chunk 2 parked undispatched.
+    journal = SurveyJournal(jdir)
+    assert sorted(journal.completed_chunks()) == [0]
+    assert sorted(journal.parked_chunks()) == [1, 2]
+    assert get_metrics().counter("chunks_parked") == 2
+    assert get_metrics().counter("breaker_opens") == 1
+    assert peaks == [p for p in expected if p.dm == 0.0]
+
+    # The fault has "cleared": resume finishes the parked chunks and
+    # the combined result matches the uninterrupted run exactly.
+    get_metrics().reset()
+    resumed = SurveyScheduler(
+        _searcher(), chunks, journal=SurveyJournal(jdir), resume=True,
+    ).run()
+    assert resumed == expected
+    assert SurveyJournal(jdir).parked_chunks() == {}
+
+
+def test_scheduler_half_open_probe_recovers(tmp_path):
+    """After the cooldown the breaker admits a probe chunk; its success
+    closes the circuit and the rest of the survey dispatches normally."""
+    files = _three_trials(tmp_path)
+    chunks = [[f] for f in files]
+
+    get_metrics().reset()
+    expected = SurveyScheduler(_searcher(), chunks).run()
+
+    get_metrics().reset()
+    # cooldown 0: the breaker is half-open by the very next chunk.
+    sched = SurveyScheduler(
+        _searcher(), chunks, journal=SurveyJournal(tmp_path / "j"),
+        retry=RetryPolicy(max_retries=0, sleep=lambda s: None),
+        faults=FaultPlan.parse("raise:0"),
+        breaker=CircuitBreaker(failure_threshold=1, cooldown_s=0.0),
+    )
+    peaks = sched.run()
+    # Chunk 0 failed (parked, circuit opened); chunk 1 was the probe,
+    # succeeded, closed the circuit; chunk 2 ran normally.
+    assert get_metrics().counter("chunks_parked") == 1
+    assert get_metrics().counter("breaker_opens") == 1
+    assert peaks == [p for p in expected if p.dm != 0.0]
+
+
+# ----------------------------------------------- pipeline end to end
+
+def _survey_config():
+    return {
+        "processes": 1,
+        "data": {"format": "presto", "fmin": None, "fmax": None,
+                 "nchans": None},
+        "dmselect": {"min": 0.0, "max": 30.0, "dmsinb_max": None},
+        "dereddening": {"rmed_width": 4.0, "rmed_minpts": 101},
+        "ranges": [{
+            "name": "test",
+            "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                           "bins_min": 64, "bins_max": 71,
+                           "fpmin": 8, "wtsp": 1.5, "ducy_max": 0.30},
+            "find_peaks": {"smin": 6.0},
+            "candidates": {"bins": 64, "subints": 8},
+        }],
+        "clustering": {"radius": 0.2},
+        "harmonic_flagging": {"denom_max": 100, "phase_distance_max": 1.0,
+                              "dm_distance_max": 3.0,
+                              "snr_distance_max": 3.0},
+        "candidate_filters": {"dm_min": None, "snr_min": 7.0,
+                              "remove_harmonics": True, "max_number": None},
+        "plot_candidates": False,
+    }
+
+
+def test_pipeline_hang_byte_identical_products(tmp_path):
+    """Acceptance: a pipeline survey with an injected hang completes
+    (watchdog cancel + retry, from the YAML-style liveness config) and
+    its peaks.csv is byte-identical to an unfaulted run's."""
+    from riptide_tpu.pipeline import Pipeline
+
+    indir = tmp_path / "data"
+    indir.mkdir()
+    files = [str(f) for f in _three_trials(indir)]
+
+    out_a = tmp_path / "out_a"
+    out_a.mkdir()
+    get_metrics().reset()
+    Pipeline(_survey_config()).process(files, str(out_a))  # warm + oracle
+
+    conf = _survey_config()
+    conf["liveness"] = {"enabled": True, "watchdog_k": 4.0,
+                        "watchdog_floor_s": 0.5, "watchdog_cap_s": 30.0,
+                        "breaker_threshold": 3,
+                        "breaker_cooldown_s": 60.0}
+    out_b = tmp_path / "out_b"
+    out_b.mkdir()
+    get_metrics().reset()
+    Pipeline(conf, journal=str(tmp_path / "journal"),
+             fault_spec="hang:2:15").process(files, str(out_b))
+    assert get_metrics().counter("chunks_timed_out") >= 1
+
+    for product in ("peaks.csv", "candidates.csv"):
+        a = (out_a / product).read_bytes()
+        b = (out_b / product).read_bytes()
+        assert a == b, f"{product} differs between unfaulted and hung run"
+    # The journal's metrics snapshot records the hang for posterity.
+    snap = SurveyJournal(str(tmp_path / "journal")).last_metrics()
+    assert snap["chunks_timed_out"] >= 1
+    assert snap["chunks_parked"] == 0

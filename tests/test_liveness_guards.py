@@ -1,0 +1,119 @@
+"""
+Tier-1 enforcement of the bounded-wait discipline: every
+``multihost_utils`` collective call site in ``riptide_tpu/`` must route
+through the liveness layer's wrappers
+(``tools/check_liveness_guards.py``), so a future call site cannot
+reintroduce an unbounded cross-process wait that deadlocks on a dead
+peer.
+"""
+import importlib.util
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+TOOL = os.path.join(REPO, "tools", "check_liveness_guards.py")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_liveness_guards",
+                                                  TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_all_collective_call_sites_guarded():
+    tool = _load_tool()
+    violations = tool.check()
+    assert violations == [], "\n".join(violations)
+
+
+def _fake_repo(tmp_path, source):
+    pkg = tmp_path / "riptide_tpu"
+    pkg.mkdir()
+    (pkg / "raw.py").write_text(source)
+    return str(tmp_path)
+
+
+def test_lint_catches_raw_collective(tmp_path):
+    """The checker must flag a raw multihost_utils call outside the
+    allowed wrappers (guard against a vacuous lint)."""
+    tool = _load_tool()
+    repo = _fake_repo(
+        tmp_path,
+        "from jax.experimental import multihost_utils\n"
+        "def gather(x):\n"
+        "    return multihost_utils.process_allgather(x)\n"
+        "def ok(x):\n"
+        "    return multihost_utils.process_allgather(x)\n"
+    )
+    allowed = {os.path.join("riptide_tpu", "raw.py"): {"ok"}}
+    violations = tool.check(repo=repo, allowed=allowed)
+    assert len(violations) == 1
+    assert "gather" in violations[0]
+
+
+def test_lint_catches_fully_qualified_and_module_level(tmp_path):
+    tool = _load_tool()
+    repo = _fake_repo(
+        tmp_path,
+        "import jax\n"
+        "jax.experimental.multihost_utils.sync_global_devices('boot')\n"
+        "def ok(x):\n"
+        "    import jax.experimental.multihost_utils as multihost_utils\n"
+        "    return multihost_utils.process_allgather(x)\n"
+    )
+    allowed = {os.path.join("riptide_tpu", "raw.py"): {"ok"}}
+    violations = tool.check(repo=repo, allowed=allowed)
+    assert len(violations) == 1
+    assert "module level" in violations[0]
+
+
+def test_lint_catches_from_import_and_alias_evasion(tmp_path):
+    """Binding a collective via ``from ...multihost_utils import X`` or
+    the module via ``import ... as Y`` would evade the attribute-call
+    check; the lint must flag the import itself."""
+    tool = _load_tool()
+    repo = _fake_repo(
+        tmp_path,
+        "from jax.experimental.multihost_utils import process_allgather\n"
+        "import jax.experimental.multihost_utils as mhu\n"
+        "def sneaky(x):\n"
+        "    return process_allgather(x)\n"
+        "def ok(x):\n"
+        "    from jax.experimental import multihost_utils\n"
+        "    return multihost_utils.process_allgather(x)\n"
+    )
+    allowed = {os.path.join("riptide_tpu", "raw.py"): {"ok"}}
+    violations = tool.check(repo=repo, allowed=allowed)
+    assert len(violations) == 2  # the two module-level import bindings
+    assert all("import" in v for v in violations)
+
+
+def test_lint_catches_module_alias_from_import(tmp_path):
+    """'from jax.experimental import multihost_utils as mu' hides the
+    module under an alias, so 'mu.process_allgather(...)' would pass
+    the attribute check; the import binding itself must be flagged."""
+    tool = _load_tool()
+    repo = _fake_repo(
+        tmp_path,
+        "from jax.experimental import multihost_utils as mu\n"
+        "def sneaky(x):\n"
+        "    return mu.process_allgather(x)\n"
+        "def ok(x):\n"
+        "    from jax.experimental import multihost_utils\n"
+        "    return multihost_utils.process_allgather(x)\n"
+    )
+    allowed = {os.path.join("riptide_tpu", "raw.py"): {"ok"}}
+    violations = tool.check(repo=repo, allowed=allowed)
+    assert len(violations) == 1
+    assert "import" in violations[0] and "module level" in violations[0]
+
+
+def test_lint_flags_vacuous_allowlist(tmp_path):
+    """Zero wrapped call sites means the wrappers vanished: the lint
+    must fail rather than silently pass forever."""
+    tool = _load_tool()
+    repo = _fake_repo(tmp_path, "x = 1\n")
+    violations = tool.check(repo=repo)
+    assert violations and "vacuous" in violations[0]

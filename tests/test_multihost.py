@@ -1,10 +1,14 @@
 """
-Real 2-process distributed runtime test: two coordinator-connected CPU
+Real 2-process distributed runtime tests: two coordinator-connected CPU
 processes (4 virtual devices each) each search their own DM shard and
 exchange Peak lists through run_search_multihost — the multi-host analog
 of the reference's tested ``processes: 2`` parallel pipeline mode
 (riptide/tests/test_pipeline.py:14-31). Exercises
-parallel/distributed.py:init_distributed with process_count > 1.
+parallel/distributed.py:init_distributed with process_count > 1, plus
+the peer-loss degradation path (one host dies; the survivor degrades to
+local-only mode, takes over the journal-writer role and finishes the
+lost shard's chunks instead of deadlocking). Unit tests cover the Peak
+wire encoding and the all-processes-empty padding path of gather_peaks.
 """
 import os
 import socket
@@ -12,6 +16,8 @@ import subprocess
 import sys
 
 import numpy as np
+
+from riptide_tpu.peak_detection import Peak
 
 _WORKER = r"""
 import os, sys
@@ -22,7 +28,10 @@ port = sys.argv[2]
 import numpy as np
 from riptide_tpu.parallel.distributed import init_distributed
 
-assert init_distributed(f"localhost:{port}", num_processes=2, process_id=pid)
+# init returns the process count (truthiness-compatible with the old
+# boolean contract).
+assert init_distributed(f"localhost:{port}", num_processes=2,
+                        process_id=pid) == 2
 
 import jax
 
@@ -57,13 +66,13 @@ print(f"worker {pid} OK: {len(peaks)} global peaks, "
 """
 
 
-def test_two_process_distributed_search(tmp_path):
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
+def _free_port():
     with socket.socket() as s:
         s.bind(("localhost", 0))
-        port = s.getsockname()[1]
+        return s.getsockname()[1]
 
+
+def _worker_env():
     env = dict(os.environ)
     env.update(
         PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -72,23 +81,228 @@ def test_two_process_distributed_search(tmp_path):
         JAX_COMPILATION_CACHE_DIR="/tmp/riptide_tpu_jax_cache",
         JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0.5",
     )
+    return env
+
+
+def _run_two_processes(tmp_path, source, extra_args=()):
+    """Launch the worker script as processes 0 and 1 of a 2-process
+    runtime; returns [(returncode, output), ...]."""
+    script = tmp_path / "worker.py"
+    script.write_text(source)
+    port = _free_port()
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(i), str(port)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
+            [sys.executable, str(script), str(i), str(port),
+             *map(str, extra_args)],
+            env=_worker_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
         )
         for i in range(2)
     ]
-    outs = []
+    results = []
     try:
         for p in procs:
             out, _ = p.communicate(timeout=600)
-            outs.append(out)
+            results.append((p.returncode, out))
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {i} failed:\n{out[-4000:]}"
+    return results
+
+
+def test_two_process_distributed_search(tmp_path):
+    results = _run_two_processes(tmp_path, _WORKER)
+    for i, (rc, out) in enumerate(results):
+        assert rc == 0, f"worker {i} failed:\n{out[-4000:]}"
         assert f"worker {i} OK" in out
+
+
+# ---------------------------------------------------------------------------
+# Peer loss: one host wedges (stops heartbeating and participating, so
+# from the survivor's side it is indistinguishable from dead — its next
+# collective would block forever); the survivor must finish ALL shards
+# instead of deadlocking in the peak gather.
+# ---------------------------------------------------------------------------
+
+_PEER_LOSS_WORKER = r"""
+import os, sys, time
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+jdir = sys.argv[3]
+
+import numpy as np
+from riptide_tpu.parallel.distributed import init_distributed
+
+assert init_distributed(f"localhost:{port}", num_processes=2,
+                        process_id=pid) == 2
+
+import riptide_tpu.parallel.multihost as mh
+from riptide_tpu.libffa import generate_signal
+from riptide_tpu.parallel import run_search_multihost
+from riptide_tpu.search import periodogram_plan
+from riptide_tpu.survey.faults import FaultPlan
+from riptide_tpu.survey.journal import SurveyJournal
+from riptide_tpu.survey.liveness import PeerLivenessMonitor
+from riptide_tpu.survey.metrics import get_metrics
+
+journal = SurveyJournal(jdir)
+monitor = PeerLivenessMonitor(journal, process_index=pid, process_count=2,
+                              max_age_s=0.2)
+sentinel = os.path.join(jdir, "survivor_done")
+
+if pid == 0:
+    # The lost host: heartbeat once, then wedge — never search chunk 0,
+    # never heartbeat again, never enter a collective. (The process
+    # itself lingers so the jax coordination service, which this
+    # process hosts, stays up; killing it outright makes the client
+    # library abort the survivor before the liveness layer can act.)
+    monitor.beat()
+    for _ in range(600):
+        if os.path.exists(sentinel):
+            break
+        time.sleep(0.1)
+    print("worker 0 OK: wedged host exiting", flush=True)
+    os._exit(0)
+
+# The survivor (process 1): let the peer's heartbeat go stale, then run
+# its own shard. The injected peer_loss stands in for the bounded
+# collective timing out — with the peer wedged, actually entering the
+# collective would hang, which is exactly what the liveness layer is
+# for. The background beater keeps THIS process fresh independent of
+# chunk progress.
+monitor.start_beating(interval_s=0.05)
+time.sleep(0.5)
+journal.write_header("peerloss-survey", 2)
+
+N, tsamp = 4096, 1e-3
+plan = periodogram_plan(N, tsamp, (1, 2, 3), 64e-3, 0.15, 64, 71)
+
+def shard(seed, with_pulsar):
+    rng = np.random.default_rng(seed)
+    batch = rng.standard_normal((2, N)).astype(np.float32)
+    if with_pulsar:
+        np.random.seed(0)
+        batch[0] = generate_signal(N, 64.0, amplitude=15.0, ducy=0.05)
+    batch -= batch.mean(axis=1, keepdims=True)
+    batch /= batch.std(axis=1, keepdims=True)
+    return batch
+
+peaks, _ = run_search_multihost(
+    plan, shard(1, True), tobs=N * tsamp, dms_local=[2.0, 3.0],
+    journal=journal, chunk_id=1, faults=FaultPlan.parse("peer_loss:1"),
+    monitor=monitor,
+)
+assert mh.is_degraded()
+assert peaks, "survivor lost its own local peaks"
+# Writer failover: process 0 is stale, so the lowest ALIVE process (us)
+# journals.
+assert monitor.lost() == [0], monitor.lost()
+assert monitor.journal_writer() == 1
+
+# Re-enqueue the lost shard's unfinished chunks from the journal and
+# finish them locally: the survivor now owns the whole survey.
+lost_chunks = monitor.unfinished_chunks(2)
+assert lost_chunks == [0], lost_chunks
+for cid in lost_chunks:
+    run_search_multihost(plan, shard(0, False), tobs=N * tsamp,
+                         dms_local=[0.0, 1.0], journal=journal,
+                         chunk_id=cid, monitor=monitor)
+
+done = sorted(journal.completed_chunks())
+assert done == [0, 1], done
+assert get_metrics().counter("peer_losses") == 1
+print(f"worker 1 OK: survived peer loss, journaled chunks {done}",
+      flush=True)
+with open(sentinel, "w") as f:
+    f.write("done")
+# Skip the distributed runtime's shutdown handshake: the wedged peer
+# will never participate in it.
+os._exit(0)
+"""
+
+
+def test_two_process_peer_loss_survivor_finishes(tmp_path):
+    """Acceptance: with process 0 lost (wedged, heartbeats stale), the
+    survivor degrades to local-only mode, takes over the journal-writer
+    role and completes BOTH shards — verified via the shared journal —
+    instead of deadlocking in the gather."""
+    from riptide_tpu.survey.journal import SurveyJournal
+
+    jdir = tmp_path / "journal"
+    results = _run_two_processes(tmp_path, _PEER_LOSS_WORKER,
+                                 extra_args=[jdir])
+    for i, (rc, out) in enumerate(results):
+        assert rc == 0, f"worker {i} failed:\n{out[-4000:]}"
+        assert f"worker {i} OK" in out
+
+    journal = SurveyJournal(jdir)
+    assert sorted(journal.completed_chunks()) == [0, 1]
+    beats = journal.read_heartbeats()
+    assert sorted(beats) == [0, 1]  # both sidecars exist
+    snap = journal.last_metrics()
+    assert snap["peer_losses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Peak wire encoding (unit)
+# ---------------------------------------------------------------------------
+
+def _peak(period=0.5, snr=10.0, dm=0.0, iw=1, ip=7, width=3):
+    return Peak(period=period, freq=1.0 / period, width=width, ducy=0.05,
+                iw=iw, ip=ip, snr=snr, dm=dm)
+
+
+def test_peak_encode_decode_roundtrip():
+    from riptide_tpu.parallel.multihost import _decode, _encode
+
+    peaks = [
+        _peak(),
+        # Large int fields must survive the float64 wire exactly
+        # (float64 is integer-exact through 2**53).
+        _peak(period=1.25, snr=8.5, dm=112.75, iw=11, ip=123456789,
+              width=1 << 40),
+    ]
+    out = _decode(_encode(peaks))
+    assert out == peaks
+    for p in out:
+        assert isinstance(p.iw, int)
+        assert isinstance(p.ip, int)
+        assert isinstance(p.width, int)
+
+
+def test_peak_encode_empty():
+    from riptide_tpu.parallel.multihost import _FIELDS, _decode, _encode
+
+    arr = _encode([])
+    assert arr.shape == (0, len(_FIELDS))
+    assert _decode(arr) == []
+
+
+def test_gather_peaks_all_processes_empty_padding(monkeypatch):
+    """When every process has zero peaks the gather still pads to one
+    row per process (allgather needs equal shapes) and must decode back
+    to an empty list, not phantom zero-peaks."""
+    import riptide_tpu.parallel.multihost as mh
+
+    mh.reset_degraded()
+    monkeypatch.setattr(mh.jax, "process_count", lambda: 2)
+    shapes = []
+
+    def fake_allgather(arr, timeout_s, what):
+        shapes.append(arr.shape)
+        return np.stack([np.zeros_like(arr), np.zeros_like(arr)])
+
+    monkeypatch.setattr(mh, "_allgather", fake_allgather)
+    assert mh.gather_peaks([]) == []
+    # One count row per process, then a single padding row of fields.
+    assert shapes == [(1,), (1, len(mh._FIELDS))]
+
+
+def test_gather_peaks_single_process_is_copy():
+    from riptide_tpu.parallel.multihost import gather_peaks
+
+    local = [_peak(), _peak(snr=8.0)]
+    out = gather_peaks(local)
+    assert out == local and out is not local
