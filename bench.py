@@ -116,20 +116,34 @@ def _parity_gate(plan, batch, tobs):
     assert dset == hset, f"device/host peak mismatch: {dset[:5]} vs {hset[:5]}"
     top = dev_peaks[0]
     assert abs(top.period - 1.0) < 1e-4, top
-    # Oracle-grade S/N band (VERDICT r4 item 5): the injected
-    # amplitude-20 pulsar's top S/N at THIS config (2^23 @ 64 us,
-    # batch-normalised) measured 17.31 (r03, uint8 wire) / 17.27 (r04,
-    # uint6) / 17.3 host float32 — the analog of the reference's
-    # 18.5 +/- 0.15 bar at its 2^19 @ 256 us config
-    # (riptide/tests/test_rseek.py:50-54).
-    assert abs(top.snr - 17.3) < 0.15, top
-    from riptide_tpu.search.engine import _ffa_path, _wire_mode
+    # Parity band derived AT RUN TIME from the exact (float32-wire)
+    # result of the same injected trial: a D=1 search of trial 0
+    # through the float32 transport gives the reference top S/N the
+    # quantised wire must reproduce within its error budget (+/- 0.15
+    # S/N — the bound the uint6 wire is sized for). Deriving the band
+    # from the run itself keeps the gate valid when the config or the
+    # quantiser changes; the self-measured 17.3 +/- 0.15 history is
+    # demoted to a secondary drift check below.
+    from riptide_tpu.search.engine import (
+        _ffa_path, _wire_mode, prepare_stage_data, run_search_batch,
+    )
+
+    prep32 = prepare_stage_data(plan, batch[:1], mode="float32")
+    ref_peaks, _ = run_search_batch(plan, None, tobs=tobs, dms=_np.zeros(1),
+                                    prepared=prep32, **PKW)
+    ref_snr = ref_peaks[0][0].snr
+    assert abs(ref_peaks[0][0].period - 1.0) < 1e-4, ref_peaks[0][0]
+    assert abs(top.snr - ref_snr) < 0.15, (top.snr, ref_snr)
+    # Secondary (historical) band: the float32 reference itself has
+    # measured 17.3 at this config across rounds r03-r05; a drift here
+    # means the SEARCH changed, not the wire.
+    assert abs(ref_snr - 17.3) < 0.3, ref_snr
 
     path = _ffa_path()
     print(
         f"parity gate: {len(dev_peaks)} peaks, top S/N {top.snr:.2f} "
-        f"at P = {top.period:.6f} s (device == host; path={path}, "
-        f"wire={_wire_mode(path)})",
+        f"(float32 reference {ref_snr:.2f}) at P = {top.period:.6f} s "
+        f"(device == host; path={path}, wire={_wire_mode(path)})",
         file=sys.stderr,
     )
 
@@ -281,11 +295,14 @@ def bench_headline():
             dt = timed_pipeline(prepper, shipper)
             npasses += 1
             if dt < best:
-                # Emit every improvement immediately (last line wins)
-                # so a later stalled pass cannot discard it.
                 best = dt
                 best_sub = _submetrics(CHUNKS, best)
-                emit(best, npasses, best_sub)
+            # Emit after EVERY pass (last line wins, so a later stalled
+            # pass cannot discard an earlier best) — each line carries
+            # the best pass's dtime-style decomposition (device_s /
+            # prep_s / wire_MBps / chunk_s) and the true pass count, so
+            # every recorded round has the full breakdown.
+            emit(best, npasses, best_sub)
 
 
 def _warm_plan(nsamp, tsamp, period_min, period_max, bins_min, bins_max,

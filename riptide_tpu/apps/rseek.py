@@ -34,7 +34,7 @@ def get_parser():
     )
     parser.add_argument(
         "-f", "--format", type=str, choices=("presto", "sigproc"), required=True,
-        help="File format of the input time series",
+        help="On-disk format of the dedispersed series to load",
     )
     parser.add_argument("--Pmin", type=float, default=1.0,
                         help="Shortest trial period, in seconds")
@@ -86,7 +86,8 @@ def get_parser():
         "loop: attempts plus backoff never exceed it, so a persistently "
         "failing search errors out instead of backing off forever",
     )
-    parser.add_argument("fname", type=str, help="Input file name")
+    parser.add_argument("fname", type=str,
+                        help="Path of the time series file to search")
     parser.add_argument("--version", action="version", version=__version__)
     return parser
 

@@ -27,9 +27,7 @@ __all__ = [
     "running_median",
     "downsample",
     "downsample_stages",
-    "prepare_wire_u12",
-    "prepare_wire_u6",
-    "prepare_wire_u8",
+    "prepare_wire_view",
     "circular_prefix_sum",
     "rollback",
     "fused_rollback_add",
@@ -149,30 +147,17 @@ def _bind(lib):
         c64, c64, c64, ctypes.c_int,              # S, nout, nthreads, as_f16
         ctypes.c_void_p,                          # out (S, D, nout)
     ]
-    lib.rn_prepare_wire_u8.restype = None
-    lib.rn_prepare_wire_u8.argtypes = [
+    lib.rn_prepare_wire_view.restype = None
+    lib.rn_prepare_wire_view.argtypes = [
         _f32("C_CONTIGUOUS"), c64, c64,           # batch, D, N
         i32p, i32p,                               # imin, imax (S, nout_pad)
         _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"),
         c64, c64,                                 # S, nout_pad
-        i32p, i64p,                               # nouts (S,), boffs (S,)
-        c64, i64p, c64,                           # totbytes, soffs, totscales
-        c64, c64,                                 # blkq, nthreads
-        _f32("C_CONTIGUOUS"),                     # scales out (D, totscales)
-        ctypes.c_void_p,                          # out (D, totbytes) u8
-    ]
-    lib.rn_prepare_wire_u6.restype = None
-    lib.rn_prepare_wire_u6.argtypes = list(lib.rn_prepare_wire_u8.argtypes)
-    lib.rn_prepare_wire_u12.restype = None
-    lib.rn_prepare_wire_u12.argtypes = [
-        _f32("C_CONTIGUOUS"), c64, c64,           # batch, D, N
-        i32p, i32p,                               # imin, imax (S, nout_pad)
-        _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"), _f32("C_CONTIGUOUS"),
-        c64, c64,                                 # S, nout_pad
-        i32p, i64p,                               # nouts (S,), boffs (S,)
-        c64, c64,                                 # totbytes, nthreads
-        _f32("C_CONTIGUOUS"),                     # scales out (S, D)
-        ctypes.c_void_p,                          # out (D, totbytes) u8
+        i32p, i64p,                               # nouts (S,), roffs (S,)
+        c64, i64p, c64,                           # tot_rows, soffs, stot
+        c64, c64, c64,                            # PW, mode, nthreads
+        _f32("C_CONTIGUOUS"),                     # scales out (D, stot)
+        ctypes.c_void_p,                          # out (D, tot_rows, PW) u8
     ]
     return lib
 
@@ -358,16 +343,22 @@ def downsample_stages(batch, imin, imax, wmin, wmax, wint, dtype=np.float32,
     return out
 
 
-def prepare_wire_u12(batch, imin, imax, wmin, wmax, wint, nouts, boffs,
-                     totbytes, nthreads=None):
-    """
-    Full 12-bit wire preparation of a (D, N) float32 batch: per-stage
-    real-factor downsampling (stage s computes only its true ``nouts[s]``
-    samples), per-(stage, trial) quantisation scale = max|v| / 2047, and
-    2-samples-in-3-bytes packing straight into the (D, totbytes) wire
-    layout with stage s at byte offset ``boffs[s]``.
+_WIRE_MODE_CODE = {"uint6": 6, "uint8": 8, "uint12": 12}
 
-    Returns (wire (D, totbytes) uint8, scales (S, D) float32).
+
+def prepare_wire_view(batch, imin, imax, wmin, wmax, wint, nouts, mode,
+                      PW, roffs, tot_rows, soffs, stot, nthreads=None):
+    """
+    Quantised wire preparation of a (D, N) float32 batch in the
+    kernel-decodable byte-plane view (the single-pass native mirror of
+    ``engine._prepare_uint`` — bit-identical bytes and scales): stage s
+    computes its true ``nouts[s]`` downsampled samples, quantises them
+    per (PW-sample) view row with scale = rowmax / qmax, and packs the
+    byte planes straight into wire rows ``roffs[s]``.
+
+    Returns (wire (D, tot_rows, PW) uint8, scales (D, stot) float32);
+    the slack regions ship as zeros / 1.0 so the fused kernel's DMA
+    over-reads stay finite.
     """
     lib = _require()
     batch = np.ascontiguousarray(batch, np.float32)
@@ -375,9 +366,9 @@ def prepare_wire_u12(batch, imin, imax, wmin, wmax, wint, nouts, boffs,
     S, nout_pad = imin.shape
     if nthreads is None:
         nthreads = min(max(os.cpu_count() or 1, 1), 32)
-    out = np.empty((D, int(totbytes)), np.uint8)
-    scales = np.empty((S, D), np.float32)
-    lib.rn_prepare_wire_u12(
+    out = np.zeros((D, int(tot_rows), int(PW)), np.uint8)
+    scales = np.ones((D, int(stot)), np.float32)
+    lib.rn_prepare_wire_view(
         batch, D, N,
         np.ascontiguousarray(imin, np.int32),
         np.ascontiguousarray(imax, np.int32),
@@ -386,80 +377,10 @@ def prepare_wire_u12(batch, imin, imax, wmin, wmax, wint, nouts, boffs,
         np.ascontiguousarray(wint, np.float32),
         S, nout_pad,
         np.ascontiguousarray(nouts, np.int32),
-        np.ascontiguousarray(boffs, np.int64),
-        int(totbytes), int(nthreads),
-        scales, out.ctypes.data,
-    )
-    return out, scales
-
-
-def prepare_wire_u8(batch, imin, imax, wmin, wmax, wint, nouts, boffs,
-                    totbytes, soffs, totscales, blkq=256, nthreads=None):
-    """
-    8-bit block-adaptive wire preparation of a (D, N) float32 batch:
-    per-stage real-factor downsampling, one byte per sample with a
-    per-``blkq``-sample-block scale = blockmax / 127 (bias 128), written
-    straight into the (D, totbytes) wire layout; block scales go to a
-    (D, totscales) float32 array with stage s at ``soffs[s]``.
-
-    Returns (wire (D, totbytes) uint8, scales (D, totscales) float32).
-    """
-    lib = _require()
-    batch = np.ascontiguousarray(batch, np.float32)
-    D, N = batch.shape
-    S, nout_pad = imin.shape
-    if nthreads is None:
-        nthreads = min(max(os.cpu_count() or 1, 1), 32)
-    out = np.empty((D, int(totbytes)), np.uint8)
-    scales = np.empty((D, int(totscales)), np.float32)
-    lib.rn_prepare_wire_u8(
-        batch, D, N,
-        np.ascontiguousarray(imin, np.int32),
-        np.ascontiguousarray(imax, np.int32),
-        np.ascontiguousarray(wmin, np.float32),
-        np.ascontiguousarray(wmax, np.float32),
-        np.ascontiguousarray(wint, np.float32),
-        S, nout_pad,
-        np.ascontiguousarray(nouts, np.int32),
-        np.ascontiguousarray(boffs, np.int64),
-        int(totbytes),
-        np.ascontiguousarray(soffs, np.int64), int(totscales),
-        int(blkq), int(nthreads),
-        scales, out.ctypes.data,
-    )
-    return out, scales
-
-
-def prepare_wire_u6(batch, imin, imax, wmin, wmax, wint, nouts, boffs,
-                    totbytes, soffs, totscales, blkq=256, nthreads=None):
-    """
-    6-bit block-adaptive wire preparation: four samples in three bytes
-    with a per-``blkq``-sample-block scale = blockmax / 31 (bias 32).
-    Same layout contract as :func:`prepare_wire_u8` at 3/4 the bytes.
-
-    Returns (wire (D, totbytes) uint8, scales (D, totscales) float32).
-    """
-    lib = _require()
-    batch = np.ascontiguousarray(batch, np.float32)
-    D, N = batch.shape
-    S, nout_pad = imin.shape
-    if nthreads is None:
-        nthreads = min(max(os.cpu_count() or 1, 1), 32)
-    out = np.empty((D, int(totbytes)), np.uint8)
-    scales = np.empty((D, int(totscales)), np.float32)
-    lib.rn_prepare_wire_u6(
-        batch, D, N,
-        np.ascontiguousarray(imin, np.int32),
-        np.ascontiguousarray(imax, np.int32),
-        np.ascontiguousarray(wmin, np.float32),
-        np.ascontiguousarray(wmax, np.float32),
-        np.ascontiguousarray(wint, np.float32),
-        S, nout_pad,
-        np.ascontiguousarray(nouts, np.int32),
-        np.ascontiguousarray(boffs, np.int64),
-        int(totbytes),
-        np.ascontiguousarray(soffs, np.int64), int(totscales),
-        int(blkq), int(nthreads),
+        np.ascontiguousarray(roffs, np.int64),
+        int(tot_rows),
+        np.ascontiguousarray(soffs, np.int64), int(stot),
+        int(PW), _WIRE_MODE_CODE[mode], int(nthreads),
         scales, out.ctypes.data,
     )
     return out, scales

@@ -28,6 +28,7 @@
 #include <atomic>
 #if defined(__x86_64__)
 #include <immintrin.h>
+#include <cpuid.h>
 #endif
 
 extern "C" {
@@ -335,8 +336,14 @@ static void f32_to_f16_vec_hw(const float* in, uint16_t* out, int64_t n) {
     for (; i < n; ++i) out[i] = f32_to_f16_rne(in[i]);
 }
 static bool f16c_supported() {
-    static const bool ok = __builtin_cpu_supports("f16c") &&
-                           __builtin_cpu_supports("avx");
+    // GCC < 11 rejects "f16c" as a __builtin_cpu_supports feature name
+    // (a hard COMPILE error, which silently cost every pre-11 host the
+    // whole native runtime): read CPUID leaf 1 ECX bit 29 directly.
+    static const bool ok = []() {
+        unsigned eax = 0, ebx = 0, ecx = 0, edx = 0;
+        if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx)) return false;
+        return (ecx & (1u << 29)) != 0 && __builtin_cpu_supports("avx");
+    }();
     return ok;
 }
 #else
@@ -669,34 +676,47 @@ void rn_downsample_stages(const float* batch, int64_t D, int64_t N,
     for (auto& th : pool) th.join();
 }
 
-// Full wire preparation for the 12-bit packed transport: every cascade
-// stage's real-factor downsampling of a (D, N) batch, quantised to 12
-// bits with a per-(stage, trial) scale = max|v| / 2047 and packed two
-// samples per three bytes DIRECTLY into the caller's (D, totbytes) wire
-// buffer (stage s of trial d at out[d * totbytes + boffs[s]]). Unlike
-// rn_downsample_stages this computes only each stage's true nouts[s]
-// samples (not the plan-wide padded length) and skips the separate
-// copy-into-wire pass, which roughly halves host prep on the survey
-// path. Quantisation bias: q = round(v / scale) + 2048 in [1, 4095];
-// 2048 encodes 0. An odd nouts[s] is padded with one zero sample.
-void rn_prepare_wire_u12(const float* batch, int64_t D, int64_t N,
-                         const int32_t* imin, const int32_t* imax,
-                         const float* wmin, const float* wmax,
-                         const float* wint, int64_t S, int64_t nout_pad,
-                         const int32_t* nouts, const int64_t* boffs,
-                         int64_t totbytes, int64_t nthreads,
-                         float* scales, uint8_t* out) {
+// Quantised wire preparation in the kernel-decodable BYTE-PLANE VIEW
+// (see riptide_tpu/search/engine.py:_view_layout): every cascade
+// stage's real-factor downsampling of a (D, N) batch, laid out per
+// stage as a (R0, PW) row view (R0 = ceil(n / PW), zero padded) with
+// one float32 scale per view row (scale = rowmax / qmax, bias-coded
+// samples q = rne(v / scale) + bias). `group` consecutive view rows
+// pack into one row of `planes` byte planes:
+//   mode  6: group 4, words q0 | q1<<6 | q2<<12 | q3<<18, 3 planes
+//   mode  8: group 1, one byte per sample, 1 plane
+//   mode 12: group 2, words q0 | q1<<12, 3 planes
+// Stage s occupies wire rows [roffs[s], roffs[s] + planes * pr) of the
+// (D, tot_rows, PW) output and scale rows [soffs[s], soffs[s] + R0) of
+// the (D, stot) scales; the caller pre-fills the wire with zeros and
+// the scales with 1.0 so the slack regions the fused kernel's
+// static-shape DMAs may over-read stay finite. Round-half-even via the
+// 1.5*2^23 magic constant, float32 reciprocal: bit-identical to the
+// numpy fallback (engine._prepare_uint).
+void rn_prepare_wire_view(const float* batch, int64_t D, int64_t N,
+                          const int32_t* imin, const int32_t* imax,
+                          const float* wmin, const float* wmax,
+                          const float* wint, int64_t S, int64_t nout_pad,
+                          const int32_t* nouts, const int64_t* roffs,
+                          int64_t tot_rows, const int64_t* soffs,
+                          int64_t stot, int64_t PW, int64_t mode,
+                          int64_t nthreads, float* scales, uint8_t* out) {
     const int64_t G = (N + ANCHOR_BLK - 1) / ANCHOR_BLK;
     std::vector<float> cs((N + 1) * D);
     std::vector<double> anchors(G * D);
     std::vector<std::thread> pool;
     if (nthreads <= 0) nthreads = 1;
     batch_prefix_sums(batch, D, N, cs.data(), anchors.data(), G, nthreads);
+    const int64_t group = mode == 8 ? 1 : (mode == 12 ? 2 : 4);
+    const float qmaxf = mode == 8 ? 127.0f : (mode == 12 ? 2047.0f : 31.0f);
+    const int32_t bias = mode == 8 ? 128 : (mode == 12 ? 2048 : 32);
+    const int32_t qmask = 2 * bias - 1;
     std::atomic<int64_t> next_job(0);
     const int64_t njobs = S * D;
     for (int64_t t = 0; t < std::min<int64_t>(nthreads, njobs); ++t) {
         pool.emplace_back([&]() {
             std::vector<float> scratch;
+            std::vector<int32_t> q(group * PW);
             int64_t job;
             while ((job = next_job.fetch_add(1)) < njobs) {
                 const int64_t s = job / D, d = job % D;
@@ -709,175 +729,59 @@ void rn_prepare_wire_u12(const float* batch, int64_t D, int64_t N,
                 const float* w1 = wmax + s * nout_pad;
                 const float* wi = wint + s * nout_pad;
                 const int64_t n = nouts[s];
-                scratch.resize(n + 1);
-                float vmax = 0.0f;
-                stage_values(x, c, anc, a, b, w0, w1, wi, scratch.data(), n,
-                             &vmax);
-                scratch[n] = 0.0f;  // pad sample for odd n
-                const float scale = vmax > 0.0f ? vmax / 2047.0f : 1.0f;
-                scales[s * D + d] = scale;
-                const float inv = 1.0f / scale;
-                uint8_t* o = out + d * totbytes + boffs[s];
-                const int64_t pairs = (n + 1) / 2;
-                // Round-half-even via the 1.5*2^23 magic constant
-                // (exact for |v| <= 2^22, and |v*inv| <= 2047 here):
-                // unlike lrintf this auto-vectorizes.
-                const float magic = 12582912.0f;  // 1.5 * 2^23
-                for (int64_t k = 0; k < pairs; ++k) {
-                    union { float f; int32_t i; } u0, u1;
-                    u0.f = scratch[2 * k] * inv + magic;
-                    u1.f = scratch[2 * k + 1] * inv + magic;
-                    // mantissa = round(v) + 2^22 for v in [-2^22, 2^22)
-                    const int32_t q0 = (u0.i & 0x7FFFFF) - 4194304 + 2048;
-                    const int32_t q1 = (u1.i & 0x7FFFFF) - 4194304 + 2048;
-                    o[3 * k] = static_cast<uint8_t>(q0 & 255);
-                    o[3 * k + 1] = static_cast<uint8_t>(
-                        ((q0 >> 8) & 15) | ((q1 & 15) << 4));
-                    o[3 * k + 2] = static_cast<uint8_t>((q1 >> 4) & 255);
-                }
-            }
-        });
-    }
-    for (auto& th : pool) th.join();
-}
-
-// 6-bit block-adaptive wire: four samples in three bytes with a
-// PER-BLOCK scale = blockmax / 31 (bias 32; q in [1, 63]; 32 encodes
-// 0). 24-bit little-endian field order: q0 | q1<<6 | q2<<12 | q3<<18.
-// Stage spans are padded to whole blocks (pad fields encode 0).
-void rn_prepare_wire_u6(const float* batch, int64_t D, int64_t N,
-                        const int32_t* imin, const int32_t* imax,
-                        const float* wmin, const float* wmax,
-                        const float* wint, int64_t S, int64_t nout_pad,
-                        const int32_t* nouts, const int64_t* boffs,
-                        int64_t totbytes, const int64_t* soffs,
-                        int64_t totscales, int64_t blkq, int64_t nthreads,
-                        float* scales, uint8_t* out) {
-    const int64_t G = (N + ANCHOR_BLK - 1) / ANCHOR_BLK;
-    std::vector<float> cs((N + 1) * D);
-    std::vector<double> anchors(G * D);
-    std::vector<std::thread> pool;
-    if (nthreads <= 0) nthreads = 1;
-    batch_prefix_sums(batch, D, N, cs.data(), anchors.data(), G, nthreads);
-    std::atomic<int64_t> next_job(0);
-    const int64_t njobs = S * D;
-    for (int64_t t = 0; t < std::min<int64_t>(nthreads, njobs); ++t) {
-        pool.emplace_back([&]() {
-            std::vector<float> scratch;
-            int64_t job;
-            while ((job = next_job.fetch_add(1)) < njobs) {
-                const int64_t s = job / D, d = job % D;
-                const float* x = batch + d * N;
-                const float* c = cs.data() + d * (N + 1);
-                const double* anc = anchors.data() + d * G;
-                const int32_t* a = imin + s * nout_pad;
-                const int32_t* b = imax + s * nout_pad;
-                const float* w0 = wmin + s * nout_pad;
-                const float* w1 = wmax + s * nout_pad;
-                const float* wi = wint + s * nout_pad;
-                const int64_t n = nouts[s];
-                const int64_t nblk = (n + blkq - 1) / blkq;
-                scratch.resize(nblk * blkq);
+                const int64_t r0 = (n + PW - 1) / PW;
+                const int64_t pr = (r0 + group - 1) / group;
+                scratch.assign(group * pr * PW, 0.0f);
                 float vmax_unused = 0.0f;
                 stage_values(x, c, anc, a, b, w0, w1, wi, scratch.data(), n,
                              &vmax_unused);
-                for (int64_t k = n; k < nblk * blkq; ++k) scratch[k] = 0.0f;
-                float* sc = scales + d * totscales + soffs[s];
-                uint8_t* o = out + d * totbytes + boffs[s];
+                float* sc = scales + d * stot + soffs[s];
+                uint8_t* ob = out + (d * tot_rows + roffs[s]) * PW;
                 const float magic = 12582912.0f;  // 1.5 * 2^23, RNE
-                for (int64_t bk = 0; bk < nblk; ++bk) {
-                    const float* v = scratch.data() + bk * blkq;
-                    float bmax = 0.0f;
-                    for (int64_t k = 0; k < blkq; ++k) {
-                        const float av = std::fabs(v[k]);
-                        if (av > bmax) bmax = av;
-                    }
-                    const float scale = bmax > 0.0f ? bmax / 31.0f : 1.0f;
-                    sc[bk] = scale;
-                    const float inv = 1.0f / scale;
-                    uint8_t* ob = o + bk * (blkq / 4) * 3;
-                    for (int64_t k = 0; k < blkq / 4; ++k) {
-                        uint32_t word = 0;
-                        for (int j = 0; j < 4; ++j) {
-                            union { float f; int32_t i; } u;
-                            u.f = v[4 * k + j] * inv + magic;
-                            const uint32_t q = static_cast<uint32_t>(
-                                ((u.i & 0x7FFFFF) - 4194304 + 32) & 63);
-                            word |= q << (6 * j);
+                for (int64_t k = 0; k < pr; ++k) {
+                    for (int64_t g = 0; g < group; ++g) {
+                        const int64_t r = k * group + g;
+                        const float* v = scratch.data() + r * PW;
+                        int32_t* qr = q.data() + g * PW;
+                        if (r >= r0) {
+                            for (int64_t j = 0; j < PW; ++j) qr[j] = bias;
+                            continue;
                         }
-                        ob[3 * k] = static_cast<uint8_t>(word & 255);
-                        ob[3 * k + 1] = static_cast<uint8_t>((word >> 8) & 255);
-                        ob[3 * k + 2] = static_cast<uint8_t>((word >> 16) & 255);
+                        float rmax = 0.0f;
+                        for (int64_t j = 0; j < PW; ++j) {
+                            const float av = std::fabs(v[j]);
+                            if (av > rmax) rmax = av;
+                        }
+                        const float scale = rmax > 0.0f ? rmax / qmaxf : 1.0f;
+                        sc[r] = scale;
+                        const float inv = 1.0f / scale;
+                        for (int64_t j = 0; j < PW; ++j) {
+                            union { float f; int32_t i; } u;
+                            u.f = v[j] * inv + magic;
+                            qr[j] = ((u.i & 0x7FFFFF) - 4194304 + bias)
+                                    & qmask;
+                        }
                     }
-                }
-            }
-        });
-    }
-    for (auto& th : pool) th.join();
-}
-
-// 8-bit block-adaptive wire: like rn_prepare_wire_u12 but one byte per
-// sample with a PER-256-SAMPLE-BLOCK scale = blockmax / 127 (bias 128;
-// q in [1, 255]; 128 encodes 0). Block adaptivity confines the coarse
-// quantisation of bright-pulsar blocks to those blocks; noise blocks
-// quantise at ~4 sigma / 127. Each stage's wire span is padded to a
-// whole number of blocks (pad bytes encode 0).
-void rn_prepare_wire_u8(const float* batch, int64_t D, int64_t N,
-                        const int32_t* imin, const int32_t* imax,
-                        const float* wmin, const float* wmax,
-                        const float* wint, int64_t S, int64_t nout_pad,
-                        const int32_t* nouts, const int64_t* boffs,
-                        int64_t totbytes, const int64_t* soffs,
-                        int64_t totscales, int64_t blkq, int64_t nthreads,
-                        float* scales, uint8_t* out) {
-    const int64_t G = (N + ANCHOR_BLK - 1) / ANCHOR_BLK;
-    std::vector<float> cs((N + 1) * D);
-    std::vector<double> anchors(G * D);
-    std::vector<std::thread> pool;
-    if (nthreads <= 0) nthreads = 1;
-    batch_prefix_sums(batch, D, N, cs.data(), anchors.data(), G, nthreads);
-    std::atomic<int64_t> next_job(0);
-    const int64_t njobs = S * D;
-    for (int64_t t = 0; t < std::min<int64_t>(nthreads, njobs); ++t) {
-        pool.emplace_back([&]() {
-            std::vector<float> scratch;
-            int64_t job;
-            while ((job = next_job.fetch_add(1)) < njobs) {
-                const int64_t s = job / D, d = job % D;
-                const float* x = batch + d * N;
-                const float* c = cs.data() + d * (N + 1);
-                const double* anc = anchors.data() + d * G;
-                const int32_t* a = imin + s * nout_pad;
-                const int32_t* b = imax + s * nout_pad;
-                const float* w0 = wmin + s * nout_pad;
-                const float* w1 = wmax + s * nout_pad;
-                const float* wi = wint + s * nout_pad;
-                const int64_t n = nouts[s];
-                const int64_t nblk = (n + blkq - 1) / blkq;
-                scratch.resize(nblk * blkq);
-                float vmax_unused = 0.0f;
-                stage_values(x, c, anc, a, b, w0, w1, wi, scratch.data(), n,
-                             &vmax_unused);
-                for (int64_t k = n; k < nblk * blkq; ++k) scratch[k] = 0.0f;
-                float* sc = scales + d * totscales + soffs[s];
-                uint8_t* o = out + d * totbytes + boffs[s];
-                const float magic = 12582912.0f;  // 1.5 * 2^23, RNE
-                for (int64_t bk = 0; bk < nblk; ++bk) {
-                    const float* v = scratch.data() + bk * blkq;
-                    float bmax = 0.0f;
-                    for (int64_t k = 0; k < blkq; ++k) {
-                        const float av = std::fabs(v[k]);
-                        if (av > bmax) bmax = av;
+                    if (mode == 8) {
+                        uint8_t* p0 = ob + k * PW;
+                        for (int64_t j = 0; j < PW; ++j)
+                            p0[j] = static_cast<uint8_t>(q[j] & 255);
+                        continue;
                     }
-                    const float scale = bmax > 0.0f ? bmax / 127.0f : 1.0f;
-                    sc[bk] = scale;
-                    const float inv = 1.0f / scale;
-                    uint8_t* ob = o + bk * blkq;
-                    for (int64_t k = 0; k < blkq; ++k) {
-                        union { float f; int32_t i; } u;
-                        u.f = v[k] * inv + magic;
-                        ob[k] = static_cast<uint8_t>(
-                            ((u.i & 0x7FFFFF) - 4194304 + 128) & 255);
+                    uint8_t* p0 = ob + k * PW;
+                    uint8_t* p1 = ob + (pr + k) * PW;
+                    uint8_t* p2 = ob + (2 * pr + k) * PW;
+                    for (int64_t j = 0; j < PW; ++j) {
+                        const uint32_t word = mode == 12
+                            ? static_cast<uint32_t>(q[j])
+                              | (static_cast<uint32_t>(q[PW + j]) << 12)
+                            : static_cast<uint32_t>(q[j])
+                              | (static_cast<uint32_t>(q[PW + j]) << 6)
+                              | (static_cast<uint32_t>(q[2 * PW + j]) << 12)
+                              | (static_cast<uint32_t>(q[3 * PW + j]) << 18);
+                        p0[j] = static_cast<uint8_t>(word & 255);
+                        p1[j] = static_cast<uint8_t>((word >> 8) & 255);
+                        p2[j] = static_cast<uint8_t>((word >> 16) & 255);
                     }
                 }
             }

@@ -42,12 +42,76 @@ from jax.experimental.pallas import tpu as pltpu
 
 log = logging.getLogger("riptide_tpu.ffa_kernel")
 
+from ..utils.compat import pallas_compiler_params
 from .slottables import (A_SHIFT, A_BITS, B_SHIFT, B_BITS, NAT_LEVELS,
                          PH_BITS, PH_MASK, build_tables)
 
-__all__ = ["ffa_snr_cycle", "NWPAD", "VMEM_LIMIT", "kernel_vmem_bytes"]
+__all__ = ["ffa_snr_cycle", "NWPAD", "VMEM_LIMIT", "kernel_vmem_bytes",
+           "WIRE_MODES", "pack_gather_words"]
 
 NWPAD = 16  # coef slots reserved per coefficient bank
+
+# Quantised wire transports the FUSED kernel prologue can decode in
+# VMEM: mode -> (group, planes). ``group`` consecutive view rows of the
+# stage's (R0, PW) sample view share one packed byte-plane row;
+# ``planes`` byte planes per stage (uint8 stores samples directly, the
+# packed modes split each group's little-endian words into byte planes
+# so the in-kernel decode is pure elementwise shifts — no byte-strided
+# lane relayout, which Mosaic cannot express densely).
+WIRE_MODES = {"uint8": (1, 1), "uint12": (2, 3), "uint6": (4, 3)}
+
+# In-kernel gather-word layout for the fused (m, p) pack: one int32 per
+# (problem, container row) holding
+#   bits 0-10   r = (i * p) mod PW       (lane phase of the row's data)
+#   bits 11-24  s = i - (i * p) // PW    (row drift; monotone in i,
+#                                         increments 0/1 since p <= PW)
+#   bit  31     valid (i < m)
+# The kernel recovers container[i, j] = view_flat[i * p + j] as an
+# MSB-first row barrel over the bits of s followed by a lane barrel over
+# the bits of r: monotone unit-increment drifts compose exactly under
+# the MSB-first schedule (s_i - s_{i - 2^k} <= 2^k <= s_i mod 2^{k+1}
+# whenever bit k of s_i is set), so the whole pack is dense rolls +
+# per-row selects — no gather, no HBM round-trip.
+PK_R_BITS = 11
+PK_S_SHIFT = PK_R_BITS
+PK_S_BITS = 14
+
+# Wire-plane DMA granularity (rows of the (D, WROWS, PW) wire view per
+# chunk): plane extents are dynamic per stage while DMA shapes must be
+# static, so planes stream in fixed 32-row chunks guarded by pl.when —
+# the over-read past a stage's last plane is then < 32 rows, which the
+# host covers with a 32-row tail slack per shipped wire part instead of
+# a full bucket-sized one.
+DMA_CHUNK = 32
+
+
+def _prcap(rows, group):
+    """Static per-plane row capacity of the fused decode scratch: covers
+    the largest plane extent any stage in a ``rows`` bucket can need
+    (ceil((rows + 1) / group) rows — n < (m + 1) * p <= (rows + 1) * PW
+    bounds the view at rows + 1), rounded up to whole DMA chunks."""
+    need = -(-(rows + 1) // group) + 1
+    return -(-need // DMA_CHUNK) * DMA_CHUNK
+
+
+def pack_gather_words(ms, ps, rows, PW):
+    """(B, rows) int32 pack words (see PK_* layout above) for one
+    bucket's problems against a plan-wide view width ``PW``."""
+    B = len(ms)
+    out = np.zeros((B, rows), np.int32)
+    i = np.arange(rows, dtype=np.int64)
+    for bi, (m, p) in enumerate(zip(ms, ps)):
+        m, p = int(m), int(p)
+        q = (i * p) // PW
+        r = (i * p) % PW
+        s = i - q
+        assert p <= PW and s.max() < (1 << PK_S_BITS), (p, PW, rows)
+        assert r.max() < (1 << PK_R_BITS)
+        w = r | (s << PK_S_SHIFT)
+        out[bi] = np.where(i < m, w | (1 << 31), w).astype(np.int64).astype(
+            np.int32
+        )
+    return out
 
 # Scoped-VMEM budget shared by the kernel's CompilerParams and the
 # engine's stage-eligibility check (search/engine.py:_kernel_eligible):
@@ -67,16 +131,33 @@ def num_level_tables(L, NL):
     return NL + 2 * (L - NL)
 
 
-def kernel_vmem_bytes(L, NL, rows, P, resident_tables):
+# Live (rows, PW) float32 temporaries of the fused prologue's pack
+# barrels (Av/Bv plus the decoded view and select scratch).
+N_LIVE_FUSED = 4
+
+
+def kernel_vmem_bytes(L, NL, rows, P, resident_tables, fused_mode=None,
+                      PW=None):
     """Worst-case scoped-VMEM bytes of one kernel program.
 
     ``resident_tables=True`` accounts for the persistent all-levels
     table scratch used when the grid iterates DM trials innermost;
     ``False`` is the streaming fallback (one level table at a time).
+    ``fused_mode`` adds the fused wire->container prologue's scratch
+    (byte planes, decoded view, scales, pack-barrel temporaries) for a
+    plan view width ``PW``.
     """
     bufs = N_LIVE_BUFS * rows * P * 4
-    ntab = num_level_tables(L, NL) if resident_tables else 1
-    return bufs + ntab * rows * 128 * 4
+    extra_tab = 1 if fused_mode else 0
+    ntab = (num_level_tables(L, NL) + extra_tab) if resident_tables else 1
+    tot = bufs + ntab * rows * 128 * 4
+    if fused_mode:
+        group, planes = WIRE_MODES[fused_mode]
+        prcap = _prcap(rows, group)
+        tot += planes * prcap * PW              # byte-plane scratch (u8)
+        tot += group * prcap * (PW * 4 + 4)     # decoded view + row scales
+        tot += N_LIVE_FUSED * rows * PW * 4     # pack barrel temporaries
+    return tot
 
 
 # Resident table scratches beyond this size reproducibly OOM-kill the
@@ -85,7 +166,7 @@ def kernel_vmem_bytes(L, NL, rows, P, resident_tables):
 RESIDENT_TABLE_CAP = 12 * 1024 * 1024
 
 
-def tables_resident(L, NL, rows, P):
+def tables_resident(L, NL, rows, P, fused_mode=None, PW=None):
     """Whether the per-bins-trial all-levels table scratch is used:
     it must fit the VMEM budget AND stay under the compiler-friendly
     size cap (larger scratches crash the Mosaic compiler — deeper
@@ -93,9 +174,11 @@ def tables_resident(L, NL, rows, P):
     RIPTIDE_KERNEL_RESIDENT=0 forces streaming everywhere."""
     if os.environ.get("RIPTIDE_KERNEL_RESIDENT") == "0":
         return False
-    tab_bytes = num_level_tables(L, NL) * rows * 128 * 4
+    ntab = num_level_tables(L, NL) + (1 if fused_mode else 0)
+    tab_bytes = ntab * rows * 128 * 4
     return (tab_bytes <= RESIDENT_TABLE_CAP
-            and kernel_vmem_bytes(L, NL, rows, P, True) < VMEM_LIMIT)
+            and kernel_vmem_bytes(L, NL, rows, P, True, fused_mode, PW)
+            < VMEM_LIMIT)
 
 
 def _roll_r(x, c, rows):
@@ -110,20 +193,13 @@ def _lane_up(x, c, P):
     return x if c == 0 else pltpu.roll(x, (P - c) % P, axis=1)
 
 
-def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
-            *, L, NL, rows, P, RS, widths, nspread, pbits, resident):
-    # Grid is (B, D) with the DM trial d innermost, so the D consecutive
-    # programs of one bins-trial b share tables: with ``resident`` the
-    # whole level-table set is DMA'd into a persistent VMEM scratch once
-    # per b (at d == 0) instead of level-by-level in every program —
-    # through a (D, B) grid the tables were re-fetched D times each.
-    b = pl.program_id(0)  # bins-trial index
-    d = pl.program_id(1)  # DM-trial index (tables are shared across it)
-    p = scal[b, 0]
-
-    cp = pltpu.make_async_copy(x_hbm.at[d, b], A, semx)
-    cp.start()
-
+def _make_load_tab(tab_hbm, T, semt, b, d, resident):
+    """Table loader shared by both kernel variants: ``resident`` DMAs
+    the whole per-b level-table set into the persistent VMEM scratch
+    once per b (at d == 0 — the grid is (B, D) with the DM trial
+    innermost so D consecutive programs share tables); streaming DMAs
+    one table per call. ``load_tab(lev, width)`` returns the table
+    widened from its lane-replicated 128 lanes to ``width``."""
     if resident:
         @pl.when(d == 0)
         def _load_tables():
@@ -131,23 +207,44 @@ def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
             cpt.start()
             cpt.wait()
 
-        def load_tab(lev):
+        def load_tab(lev, width):
             tv = T[lev]
-            return tv if P == 128 else pltpu.repeat(tv, P // 128, axis=1)
+            return tv if width == 128 else pltpu.repeat(tv, width // 128,
+                                                        axis=1)
 
     else:
-        def load_tab(lev):
+        def load_tab(lev, width):
             cpt = pltpu.make_async_copy(tab_hbm.at[b, lev], T, semt)
             cpt.start()
             cpt.wait()
-            # The words are lane-replicated in HBM; widen 128 -> P lanes
-            # with a tiled repeat (a width-1 lane slice + broadcast
-            # SIGABRTs the Mosaic compiler at rows >= 8 sublane tiles).
+            # The words are lane-replicated in HBM; widen 128 -> width
+            # lanes with a tiled repeat (a width-1 lane slice +
+            # broadcast SIGABRTs the Mosaic compiler at rows >= 8
+            # sublane tiles).
             tv = T[:]
-            return tv if P == 128 else pltpu.repeat(tv, P // 128, axis=1)
+            return tv if width == 128 else pltpu.repeat(tv, width // 128,
+                                                        axis=1)
 
+    return load_tab
+
+
+def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
+            *, L, NL, rows, P, RS, widths, nspread, pbits, resident):
+    b = pl.program_id(0)  # bins-trial index
+    d = pl.program_id(1)  # DM-trial index (tables are shared across it)
+    p = scal[b, 0]
+
+    cp = pltpu.make_async_copy(x_hbm.at[d, b], A, semx)
+    cp.start()
+    load_tab = _make_load_tab(tab_hbm, T, semt, b, d, resident)
     cp.wait()
+    _cascade_body(scal, coef, lambda lev: load_tab(lev, P), out_ref,
+                  A, Bs, b, p, L=L, NL=NL, rows=rows, P=P, RS=RS,
+                  widths=widths, nspread=nspread, pbits=pbits)
 
+
+def _cascade_body(scal, coef, load_tab, out_ref, A, Bs, b, p,
+                  *, L, NL, rows, P, RS, widths, nspread, pbits):
     cols = jax.lax.broadcasted_iota(jnp.int32, (rows, P), 1)
     colmask = cols < p
 
@@ -172,7 +269,13 @@ def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
         lone = bf == (1 << B_BITS) - 1
         sv = src[:]
         head = sv
-        for c in range(1, 1 << l):
+        # Head drift dh = s - h(s) is bounded by the tail child size:
+        # h(s) = round(kh * s) >= kh * s - 1/2 gives dh <= s * mt /
+        # (mn - 1) + 1/2 <= mt <= 2^(l-1) (asserted at table-build
+        # time), so the select chain stops there — the former 2^l - 1
+        # bound burnt ~2x the rolls+selects at the deepest natural
+        # level for candidates no table entry can name.
+        for c in range(1, (1 << (l - 1)) + 1):
             head = jnp.where(af == c, _roll_r(sv, c, rows), head)
         dst[:] = head
         tail = jnp.zeros((rows, P), jnp.float32)
@@ -268,6 +371,116 @@ def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
     out_ref[0, 0] = acc
 
 
+def _fused_kernel(stagevec, scal, coef, wire_hbm, scales_hbm, tab_hbm,
+                  out_ref, A, Bs, T, WB, SC, semt, semw, sems,
+                  *, mode, L, NL, rows, P, RS, widths, nspread, pbits,
+                  sbits, resident, PW):
+    """Single-dispatch cascade stage: wire decode + dequant + (m, p)
+    pack + FFA + boxcar S/N in ONE Pallas program. The per-stage wire
+    bytes arrive as a slice of the shipped (D, WROWS, PW) byte-plane
+    view (dynamic row offsets from the SMEM stage vector, streamed in
+    static DMA_CHUNK-row chunks), so the former per-stage XLA pack
+    program — and its full (D, B, rows, P) f32 container round-trip
+    through HBM — disappears entirely."""
+    b = pl.program_id(0)  # bins-trial index
+    d = pl.program_id(1)  # DM-trial index (tables are shared across it)
+    p = scal[b, 0]
+    roff = stagevec[0, 0]   # stage's wire row offset (part-relative)
+    pr = stagevec[0, 1]     # stage's rows per byte plane
+    soff = stagevec[0, 2]   # stage's scale row offset
+    r0 = stagevec[0, 3]     # stage's view rows (= ceil(n / PW))
+    group, planes = WIRE_MODES[mode]
+    PR = _prcap(rows, group)
+    R0C = group * PR
+    NCH = PR // DMA_CHUNK
+
+    cps = pltpu.make_async_copy(
+        scales_hbm.at[d, pl.ds(soff, R0C)], SC, sems
+    )
+    cps.start()
+
+    def chunk_copy(pi, c):
+        return pltpu.make_async_copy(
+            wire_hbm.at[d, pl.ds(roff + pi * pr + c * DMA_CHUNK,
+                                 DMA_CHUNK)],
+            WB.at[pi, pl.ds(c * DMA_CHUNK, DMA_CHUNK)],
+            semw.at[pi, c],
+        )
+
+    # Start every needed wire chunk (plane extents are dynamic, chunk
+    # shapes static), then overlap the per-b table DMA with the stream.
+    for pi in range(planes):
+        for c in range(NCH):
+            @pl.when(c * DMA_CHUNK < pr)
+            def _start(pi=pi, c=c):
+                chunk_copy(pi, c).start()
+
+    load_tab = _make_load_tab(tab_hbm, T, semt, b, d, resident)
+
+    for pi in range(planes):
+        for c in range(NCH):
+            @pl.when(c * DMA_CHUNK < pr)
+            def _wait(pi=pi, c=c):
+                chunk_copy(pi, c).wait()
+    cps.wait()
+
+    # ---- decode: byte planes -> dequantised (R0C, PW) sample view ------
+    # Elementwise only: the host's plane layout groups `group`
+    # consecutive view rows per plane row, so the bit extraction never
+    # crosses lanes; the group interleave is a sublane stack/reshape
+    # (the same relayout family as the slot phase's row-doubling).
+    # Operation order matches engine._u*_decode_view exactly, so the
+    # fused container is BIT-identical to the XLA pack path's.
+    if mode == "uint8":
+        xq = WB[0].astype(jnp.float32) - 128.0
+    else:
+        b0 = WB[0].astype(jnp.int32)
+        b1 = WB[1].astype(jnp.int32)
+        b2 = WB[2].astype(jnp.int32)
+        if mode == "uint6":
+            word = b0 | (b1 << 8) | (b2 << 16)
+            qs = [((word >> (6 * j)) & 63).astype(jnp.float32) - 32.0
+                  for j in range(4)]
+        else:  # uint12
+            qs = [(b0 | ((b1 & 15) << 8)).astype(jnp.float32) - 2048.0,
+                  ((b1 >> 4) | (b2 << 4)).astype(jnp.float32) - 2048.0]
+        xq = jnp.stack(qs, axis=1).reshape(R0C, PW)
+    rowi = jax.lax.broadcasted_iota(jnp.int32, (R0C, PW), 0)
+    x = xq * jnp.broadcast_to(SC[:], (R0C, PW))
+    # Rows beyond the stage's view are DMA over-read garbage (possibly
+    # times a non-finite scale): zero them BEFORE the pack barrels.
+    x = jnp.where(rowi < r0, x, 0.0)
+    y = x[:rows]  # R0C >= rows + 1 by _prcap construction
+
+    # ---- pack: container[i, j] = view_flat[i * p + j] ------------------
+    pw = load_tab(0, PW)
+    rphase = pw & ((1 << PK_R_BITS) - 1)
+    sdrift = (pw >> PK_S_SHIFT) & ((1 << PK_S_BITS) - 1)
+    av = y                     # will become view[q_i, (j + r_i) mod PW]
+    bv = _roll_r(y, -1, rows)  # and view[q_i + 1, ...] for the wrap
+    # MSB-first row barrel over the monotone drift s_i = i - q_i: exact
+    # because s has unit increments (see pack_gather_words).
+    for k in reversed(range(sbits)):
+        take = ((sdrift >> k) & 1) != 0
+        av = jnp.where(take, pltpu.roll(av, 1 << k, axis=0), av)
+        bv = jnp.where(take, pltpu.roll(bv, 1 << k, axis=0), bv)
+    for k in range((PW - 1).bit_length()):
+        take = ((rphase >> k) & 1) != 0
+        av = jnp.where(take, _lane_up(av, 1 << k, PW), av)
+        bv = jnp.where(take, _lane_up(bv, 1 << k, PW), bv)
+    colsw = jax.lax.broadcasted_iota(jnp.int32, (rows, PW), 1)
+    xpk = jnp.where(colsw < (PW - rphase), av, bv)
+    xpk = jnp.where((pw < 0) & (colsw < p), xpk, 0.0)
+    if P < PW:
+        # Lane-split sub-buckets run the merge tree at their own (
+        # narrower) container width; the view width is plan-wide.
+        xpk = xpk[:, :P]
+    A[:] = xpk
+    _cascade_body(scal, coef, lambda lev: load_tab(1 + lev, P), out_ref,
+                  A, Bs, b, p, L=L, NL=NL, rows=rows, P=P, RS=RS,
+                  widths=widths, nspread=nspread, pbits=pbits)
+
+
 def _pack_scal(tables, rows):
     """(B, 32) int32 scalar bank for one bucket's problems."""
     B = len(tables)
@@ -315,7 +528,10 @@ def _pack_coef(ps, widths, hcoef, bcoef, stdnoise):
 # warmed during a build round stay valid for the driver's fresh-process
 # benchmark run afterwards (round 4 recorded no number because content
 # keying invalidated every entry, VERDICT r4 item 1).
-KERNEL_CACHE_VERSION = 5
+# v6: fused wire->kernel stages (decode + dequant + pack moved into the
+# kernel prologue, pack-word table prepended at index 0), natural-level
+# head-chain trim to the provable 2^(l-1) drift bound.
+KERNEL_CACHE_VERSION = 6
 
 
 def _hash_code_object(h, code):
@@ -350,9 +566,10 @@ def kernel_code_digest():
     from . import slottables
 
     h = hashlib.sha1()
-    for fn in (_kernel, _pack_scal, _pack_coef, slottables.pack_word,
-               slottables.build_tables, slottables._merge_tables,
-               slottables.container_rows):
+    for fn in (_kernel, _fused_kernel, _cascade_body, _make_load_tab,
+               pack_gather_words, _pack_scal, _pack_coef,
+               slottables.pack_word, slottables.build_tables,
+               slottables._merge_tables, slottables.container_rows):
         h.update(fn.__name__.encode())
         _hash_code_object(h, fn.__code__)
     return h.hexdigest()
@@ -475,7 +692,7 @@ def _build_call(L, NL, rows, P, RS, widths, nspread, pbits, D, B, interpret):
         # live; at the deepest bucket (2048, 384) that exceeds the 16M
         # default scoped-vmem limit (budget shared with the engine's
         # eligibility check via kernel_vmem_bytes).
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT),
+        compiler_params=pallas_compiler_params(vmem_limit_bytes=VMEM_LIMIT),
         interpret=bool(interpret),
     )
     jitted = jax.jit(call)
@@ -486,6 +703,74 @@ def _build_call(L, NL, rows, P, RS, widths, nspread, pbits, D, B, interpret):
         ((B, 32), jnp.int32),
         ((B, 32), jnp.float32),
         ((D, B, rows, P), jnp.float32),
+        ((B, ntab, rows, 128), jnp.int32),
+    )
+    return _CachedCall(key, jitted, arg_shapes)
+
+
+@functools.lru_cache(maxsize=128)
+def _build_fused_call(mode, L, NL, rows, P, RS, widths, nspread, pbits,
+                      sbits, D, B, PW, wrows, srows, interpret):
+    """Compiled fused wire->container->FFA->S/N program (one device
+    dispatch per cascade stage). Keyed like :func:`_build_call` plus the
+    wire mode, plan view width and the shipped wire/scale row counts
+    (the last two only retrace, never re-bucket — the kernel body and
+    scratch shapes depend on (mode, rows, P, PW) alone, so stages
+    sharing a shape bucket share one Mosaic build exactly as before)."""
+    resident = tables_resident(L, NL, rows, P, fused_mode=mode, PW=PW)
+    group, planes = WIRE_MODES[mode]
+    PR = _prcap(rows, group)
+    kern = functools.partial(
+        _fused_kernel, mode=mode, L=L, NL=NL, rows=rows, P=P, RS=RS,
+        widths=widths, nspread=nspread, pbits=pbits, sbits=sbits,
+        resident=resident, PW=PW,
+    )
+    ntab = num_level_tables(L, NL) + 1  # + the pack-word table (index 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(B, D),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # stage vector (1, 8)
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # scal (B, 32)
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # coef (B, 32)
+            pl.BlockSpec(memory_space=pl.ANY),       # wire (D, wrows, PW)
+            pl.BlockSpec(memory_space=pl.ANY),       # scales (D, srows, 1)
+            pl.BlockSpec(memory_space=pl.ANY),       # tables
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, RS, 128), lambda b, d: (d, b, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, P), jnp.float32),
+            pltpu.VMEM((rows, P), jnp.float32),
+            pltpu.VMEM((ntab, rows, 128) if resident else (rows, 128),
+                       jnp.int32),
+            pltpu.VMEM((planes, PR, PW), jnp.uint8),
+            pltpu.VMEM((group * PR, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((planes, PR // DMA_CHUNK)),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    call = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((D, B, RS, 128), jnp.float32),
+        compiler_params=pallas_compiler_params(vmem_limit_bytes=VMEM_LIMIT),
+        interpret=bool(interpret),
+    )
+    jitted = jax.jit(call)
+    if interpret:
+        return jitted
+    key = ("fused", mode, L, NL, rows, P, RS, widths, nspread, pbits,
+           sbits, D, B, PW, wrows, srows, resident)
+    arg_shapes = (
+        ((1, 8), jnp.int32),
+        ((B, 32), jnp.int32),
+        ((B, 32), jnp.float32),
+        ((D, wrows, PW), jnp.uint8),
+        ((D, srows, 1), jnp.float32),
         ((B, ntab, rows, 128), jnp.int32),
     )
     return _CachedCall(key, jitted, arg_shapes)
@@ -562,11 +847,14 @@ class CycleKernel:
                 words[i, NL : NL + self.nspread] = t.spread_words
                 words[i, NL + self.nspread :] = t.slot_words
         self.words = words
+        self.ms = ms
+        self.ps = ps
         self.scal = _pack_scal(tabs, rows)
         self.coef = _pack_coef(ps, widths, np.asarray(hcoef),
                                np.asarray(bcoef), np.asarray(stdnoise))
         self.interpret = bool(interpret)
         self._dev = None
+        self._dev_fused = {}
 
     def _operands(self):
         if self._dev is None:
@@ -587,6 +875,55 @@ class CycleKernel:
         return _build_call(self.L, self.NL, self.rows, self.P, self.RS,
                            self.widths, self.nspread, self.pbits,
                            D, self.B, self.interpret)
+
+    # -- fused single-dispatch path --------------------------------------
+
+    def _sbits(self, PW):
+        """Static bit count of the pack row drift for this bucket: the
+        drift is monotone with maximum (rows-1) - ((rows-1) * p) // PW,
+        largest for the bucket's smallest p."""
+        i = self.rows - 1
+        smax = max(i - (i * p) // PW for p in self.ps)
+        return max(smax.bit_length(), 1)
+
+    def _operands_fused(self, PW):
+        """Device operands of the fused call for plan view width ``PW``:
+        level words prefixed with the PW-specific pack-word table at
+        index 0, lane-replicated on device like the level words."""
+        dev = self._dev_fused.get(PW)
+        if dev is None:
+            pack = pack_gather_words(self.ms, self.ps, self.rows, PW)
+            words = np.concatenate([pack[:, None], self.words], axis=1)
+            w = jnp.asarray(words)
+            wrep = jnp.broadcast_to(w[..., None], w.shape + (128,))
+            dev = self._dev_fused[PW] = (
+                jnp.asarray(self.scal),
+                jnp.asarray(self.coef),
+                jnp.asarray(wrep),
+            )
+        return dev
+
+    def build_fused(self, D, mode, PW, wrows, srows):
+        """The compiled fused wire->FFA->S/N call (one device dispatch
+        per stage) for a DM-batch of ``D`` reading a shipped
+        (D, wrows, PW) wire part and (D, srows, 1) scale view."""
+        return _build_fused_call(mode, self.L, self.NL, self.rows, self.P,
+                                 self.RS, self.widths, self.nspread,
+                                 self.pbits, self._sbits(PW), D, self.B,
+                                 PW, wrows, srows, self.interpret)
+
+    def run_fused(self, stagevec, wire_dev, scales_dev, mode):
+        """Queue the fused single-dispatch program: ``stagevec`` is the
+        (1, 8) int32 stage vector [wire row offset, plane rows, scale
+        row offset, view rows, 0...]; returns (D, B, RS, 128) f32."""
+        PW = int(wire_dev.shape[2])
+        scal, coef, wrep = self._operands_fused(PW)
+        call = self.build_fused(int(wire_dev.shape[0]), mode, PW,
+                                int(wire_dev.shape[1]),
+                                int(scales_dev.shape[1]))
+        if isinstance(wire_dev, jax.core.Tracer) and hasattr(call, "jitted"):
+            call = call.jitted  # inside an outer trace (see __call__)
+        return call(stagevec, scal, coef, wire_dev, scales_dev, wrep)
 
     def __call__(self, x):
         """x: (B, rows, P) or (D, B, rows, P) f32 natural-packed

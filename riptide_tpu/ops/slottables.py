@@ -168,7 +168,12 @@ def build_tables(m, p, L=None, R=None):
             o = mh + tt - s                      # tail read offset
             bb[base : base + mn] = o + 1         # in [0, 2**(l-1) + 1]
             sig[base : base + mn] = sh
-            assert (s - h >= 0).all() and (s - h < (1 << A_BITS)).all()
+            # Head drift is bounded by the tail child size: h(s) =
+            # round(kh*s) >= kh*s - 1/2 gives s - h <= s*mt/(mn-1) + 1/2
+            # <= mt <= 2^(l-1). The kernel's head select chain stops at
+            # that bound (ffa_kernel natural levels), so it is asserted
+            # here at table-build time.
+            assert (s - h >= 0).all() and (s - h <= (1 << (l - 1))).all(), (m, l)
             assert (o + 1 >= 0).all() and (o + 1 < (1 << B_BITS) - 1).all(), (m, l)
         sigm = sig % p
         thr = p - sigm
@@ -313,9 +318,11 @@ def simulate_dense(data, L=None, P=None, R=None):
         a = ((w >> A_SHIFT) & ((1 << A_BITS) - 1)).astype(np.int64)
         b = ((w >> B_SHIFT) & ((1 << B_BITS) - 1)).astype(np.int64)
         lone = b == (1 << B_BITS) - 1
-        # head: K-way select over row rolls up by c = a(u)
+        # head: K-way select over row rolls up by c = a(u); the chain
+        # stops at the provable drift bound 2^(l-1) (see build_tables),
+        # matching the kernel's trimmed select chain.
         head = buf.copy()
-        for c in range(1, 1 << l):
+        for c in range(1, (1 << (l - 1)) + 1):
             if not (a == c).any():
                 continue
             head = np.where((a == c)[:, None], _row_roll(buf, -c), head)

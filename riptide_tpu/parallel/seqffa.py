@@ -54,6 +54,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as Pspec
 
 from ..ops.ffa import ffa_transform_padded
@@ -232,7 +234,7 @@ def _seq_program(m, p, mesh, axis):
         return y
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(
@@ -303,7 +305,7 @@ def _seq_program_windowed(m, p, mesh, axis):
         return y
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(

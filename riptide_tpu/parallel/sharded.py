@@ -30,25 +30,30 @@ its local (D/n_dm, B) grid. The bins axis is only supported on the
 gather path (the fused kernel serves a full bins-trial bucket per
 program); a bins-sharded mesh falls back to the gather path per stage.
 """
+import logging
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as Pspec
 
 from ..search.engine import (
+    _WIRE_Q,
     _assemble,
     _assemble_device,
     _ffa_path,
     _kernel_eligible,
     _pack_container,
     _peak_plan,
-    _scale_layout,
     _stage_operands,
     _stage_unpack,
     _wire_mode,
     prepare_stage_data,
 )
+from ..utils.compat import shard_map
 from ..utils.exec_cache import _Cached
+
+log = logging.getLogger("riptide_tpu.parallel.sharded")
 
 __all__ = ["run_periodogram_sharded", "run_search_sharded",
            "queue_search_sharded", "collect_search_sharded",
@@ -87,19 +92,17 @@ def ship_stage_data_sharded(plan, prepared, mesh):
     D/n_dm slice). Returns ``(flat_dev, meta)`` for
     :func:`queue_search_sharded`'s ``shipped``."""
     flat, meta = prepared
-    dmsh = NamedSharding(mesh, Pspec("dm", None))
+    # Quantised wires ship the 3-D (D, WROWS, PW) byte-plane view;
+    # float wires the flat (D, total) sample buffer. Both dm-sharded on
+    # the leading axis, scales uniformly (D, STOT, 1) for every
+    # quantised mode (the per-view-row scale layout removed the old
+    # uint12 (S, D) special case).
+    dmsh = NamedSharding(mesh, Pspec("dm", *(None,) * (flat.ndim - 1)))
     flat_dev = jax.device_put(flat, dmsh)
     meta = dict(meta)
     if meta["scales"] is not None:
-        if meta["mode"] == "uint12":
-            # (S, D) layout: dm is the second axis.
-            sc_sh = NamedSharding(mesh, Pspec(None, "dm"))
-        else:
-            sc_sh = dmsh
-        meta["scales_dev"] = jax.device_put(meta["scales"], sc_sh)
-    if meta["mode"] in ("uint8", "uint6"):
-        soffs, nblks, _ = _scale_layout(plan)
-        meta["soffs"], meta["nblks"] = soffs, nblks
+        sc_sh = NamedSharding(mesh, Pspec("dm", None, None))
+        meta["scales_dev"] = jax.device_put(meta["scales"][..., None], sc_sh)
     return flat_dev, meta
 
 
@@ -121,9 +124,11 @@ def _stage_sharded_call(mesh, st, plan, meta, i, with_bins):
 
     dm = Pspec("dm")
     dm2 = Pspec("dm", None)
-    # uint12 scales are (S, D); uint6/uint8 scales are (D, stot).
-    sc_spec = Pspec(None, "dm") if mode == "uint12" else dm2
-    has_scales = mode in ("uint6", "uint8", "uint12")
+    has_scales = mode in _WIRE_Q
+    # Quantised wires: (D, WROWS, PW) byte view + (D, STOT, 1) scales;
+    # float wires: (D, total) samples (scales operand is a placeholder).
+    wire_spec = Pspec("dm", None, None) if has_scales else dm2
+    sc_spec = Pspec("dm", None, None)
     n = st.n
     # Cross-process AOT cache for the compiled shard_map program: the
     # Pallas kernel inlines into it (an AOT executable cannot take the
@@ -138,9 +143,23 @@ def _stage_sharded_call(mesh, st, plan, meta, i, with_bins):
     use_kernel = (
         path == "kernel" and not with_bins and _kernel_eligible(st, plan)
     )
+    if path == "kernel" and with_bins and _kernel_eligible(st, plan):
+        # The fused kernel serves a full bins-trial bucket per program,
+        # so a bins-sharded mesh cannot split its grid: this is a REAL
+        # downgrade (the XLA gather formulation is orders of magnitude
+        # slower per stage on TPU), not a silent routing choice.
+        log.warning(
+            "bins-sharded mesh %s: stage %d falls back from the fused "
+            "Pallas kernel to the XLA gather path (the kernel serves a "
+            "whole bins-trial bucket per program); use a 1-D dm mesh "
+            "for the kernel path", dict(mesh.shape),
+            plan.stages.index(st),
+        )
     if use_kernel:
         # interpret mode on CPU backends (virtual test meshes), like the
-        # unsharded engine path.
+        # unsharded engine path. Inside shard_map the decode + pack +
+        # Pallas kernel all inline into ONE compiled program per stage,
+        # so the sharded kernel path is already single-dispatch.
         kern = st.cycle_kernel(interpret=jax.default_backend() == "cpu")
         shapes = tuple(zip(st.ms_padded, st.ps_padded))
         remax = max(st.rows_eval_max, 1)
@@ -151,13 +170,13 @@ def _stage_sharded_call(mesh, st, plan, meta, i, with_bins):
             x = _pack_container(xd, shapes, kern.rows, kern.P)
             return kern(x)[..., :remax, :nw]
 
-        in_specs = (dm2, sc_spec) if has_scales else (dm2,)
+        in_specs = (wire_spec, sc_spec) if has_scales else (wire_spec,)
         # check_vma=False: pallas_call output avals carry no
         # varying-mesh-axes annotation, which the default shard_map
         # checking rejects on real (non-interpret) backends; the kernel
         # program contains no collectives, so the check has nothing to
         # verify here.
-        smapped = _Cached(jax.jit(jax.shard_map(
+        smapped = _Cached(jax.jit(shard_map(
             local, mesh=mesh, in_specs=in_specs, out_specs=dm,
             check_vma=False,
         )), cache_name)
@@ -178,12 +197,12 @@ def _stage_sharded_call(mesh, st, plan, meta, i, with_bins):
             )
 
         in_specs = (
-            dm2, sc_spec,
+            wire_spec, sc_spec,
             Pspec(None, b, None), Pspec(None, b, None), Pspec(None, b, None),
             Pspec(b), Pspec(b),
             Pspec(b, None), Pspec(b, None), Pspec(b),
         )
-        smapped = _Cached(jax.jit(jax.shard_map(
+        smapped = _Cached(jax.jit(shard_map(
             local, mesh=mesh, in_specs=in_specs,
             out_specs=Pspec("dm", b, None, None),
         )), cache_name)
@@ -194,7 +213,7 @@ def _stage_sharded_call(mesh, st, plan, meta, i, with_bins):
             if scales is None:
                 # Placeholder operand so the program signature is
                 # uniform; float modes never read it.
-                scales = jnp.zeros((flat_dev.shape[0], 1), jnp.float32)
+                scales = jnp.zeros((flat_dev.shape[0], 1, 1), jnp.float32)
             return smapped(
                 flat_dev, scales, ops["h"], ops["t"], ops["shift"],
                 ops["p"], ops["m"], ops["hcoef"], ops["bcoef"],
@@ -265,7 +284,8 @@ def queue_search_sharded(plan, batch, tobs, mesh=None, shipped=None,
     pp = _peak_plan(plan, tobs, **peak_kwargs)
     outs, D = _queue_stages_sharded(plan, batch, mesh, shipped=shipped,
                                     mode=mode)
-    snr_dev = _assemble_device(plan, *outs)
+    layout = (None,) * len(outs)
+    snr_dev = _assemble_device(plan, layout, *[(o,) for o in outs])
     return pp, queue_find_peaks(pp, snr_dev), D
 
 

@@ -264,22 +264,9 @@ def _pack_container(xd, shapes, rows, P):
     return jnp.stack(outs, axis=-3)
 
 
-def _slice_decode(mode, flat, scales, off, nb, soff, nblk, n):
-    """Slice + decode ONE stage's samples out of the flat wire buffer:
-    the single definition of the wire transport's device-side inverse,
-    shared by every jitted pack/unpack wrapper below AND the sharded
-    path's in-shard_map decode (:func:`_stage_unpack`). ``scales`` is
-    the stage's scale operand (block scales for uint6/uint8, the
-    per-trial scale row for uint12, ignored for float modes). Returns
-    (..., n) float32."""
-    if mode in ("uint6", "uint8"):
-        seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
-        sc = jax.lax.slice_in_dim(scales, soff, soff + nblk, axis=-1)
-        dec = _u6_decode if mode == "uint6" else _u8_decode
-        return dec(seg, sc)[..., :n]
-    if mode == "uint12":
-        seg = jax.lax.slice_in_dim(flat, off, off + nb, axis=-1)
-        return _u12_decode(seg, scales)[..., :n]
+def _slice_decode_float(flat, off, n):
+    """Slice ONE stage's samples out of the flat float wire buffer and
+    promote to float32 (float16 wires accumulate badly otherwise)."""
     xd = jax.lax.slice_in_dim(flat, off, off + n, axis=-1)
     return xd.astype(jnp.float32)
 
@@ -290,9 +277,11 @@ def _pack_static(flat, off, n, shapes, rows, P):
     Static pack, fused with the stage's slice of the all-stages wire
     buffer: take flat[..., off : off+n], then :func:`_pack_container`.
     One dispatch per stage — through the device tunnel, per-dispatch
-    overhead is material.
+    overhead is material. (Float wires only; the quantised transports
+    run the fused single-dispatch kernel, or :func:`_pack_static_view`
+    when a stage falls back to the two-dispatch form.)
     """
-    xd = _slice_decode("float", flat, None, off, 0, 0, 0, n)
+    xd = _slice_decode_float(flat, off, n)
     return _pack_container(xd, shapes, rows, P)
 
 
@@ -301,16 +290,21 @@ def _wire_mode(path):
     a ~20-70 MB/s tunneled device the wire is the survey throughput
     ceiling, so bytes are the metric that matters.
 
-    'uint6' (default on the kernel path): four samples in three bytes
-    with a per-256-sample-block scale = blockmax / 31 — block
-    adaptivity confines coarse steps to the (rare) bright-signal
-    blocks while noise blocks quantise at ~4 sigma / 31; measured S/N
-    error at the 18.5 oracle is ~0.014 (enforced by tests), at 3/8 of
-    float16's bytes. 'uint8': one byte per sample, scale = blockmax /
-    127 (~0.009 at the oracle). 'uint12': 12-bit, two samples in three
-    bytes, per-(stage, trial) scale (error <= max/4094 per sample).
+    The quantised modes ship a kernel-decodable BYTE-PLANE VIEW (see
+    :func:`_view_layout`): each stage's samples laid out as (R0, PW)
+    rows with one float32 scale per row (scale = rowmax / qmax — the
+    same block adaptivity as before with the block boundary moved to
+    the view row, so the fused kernel reads scales as a dense
+    (R0, 1) -> (R0, PW) broadcast instead of a strided gather).
+
+    'uint6' (default on the kernel path): four samples in three bytes,
+    scale = rowmax / 31 — adaptivity confines coarse steps to the
+    (rare) bright-signal rows while noise rows quantise at ~4 sigma /
+    31, at 3/8 of float16's bytes. 'uint8': one byte per sample,
+    rowmax / 127. 'uint12': two samples in three bytes, rowmax / 2047.
     'float16' costs ~5e-4 relative per sample; 'float32' is exact
-    (gather-path default). Override with
+    (gather-path default); float modes ship the flat element buffer of
+    the XLA pack path. Override with
     RIPTIDE_WIRE_DTYPE=float32|float16|uint12|uint8|uint6.
     """
     mode = os.environ.get("RIPTIDE_WIRE_DTYPE")
@@ -322,256 +316,210 @@ def _wire_mode(path):
     return "uint6" if path == "kernel" else "float32"
 
 
-# Quantisation block of the uint8 wire: one float32 scale per BLKQ
-# samples (scale overhead 4/256 bytes/sample).
-BLKQ = 256
+# Quantisation parameters per wire mode: (qmax, bias). One float32
+# scale per PW-sample view row, scale = rowmax / qmax, stored value
+# q = rint(v / scale) + bias.
+_WIRE_Q = {"uint6": (31.0, 32), "uint8": (127.0, 128),
+           "uint12": (2047.0, 2048)}
+
+
+def _view_width(plan):
+    """Plan-wide wire view width PW: the padded lane width of the
+    widest phase-bin trial. One width for every stage, so a single
+    (D, WROWS, PW) byte tensor carries the whole cascade and the fused
+    kernel's row/lane pack barrels see a constant modulus."""
+    return -(-int(plan.P) // 128) * 128
+
+
+def _view_layout(plan, mode):
+    """Row bookkeeping of a quantised wire view, cached on the plan.
+
+    Stage s ships as ``planes`` byte planes of ``prs[s]`` rows x PW
+    bytes (``group`` consecutive view rows per plane row — see
+    ops.ffa_kernel.WIRE_MODES) at wire row offset ``roffs[s]``, plus
+    ``r0s[s]`` per-row float32 scales at scale row ``soffs[s]``.
+    ``tot_rows``/``stot`` include the tail slack the fused kernel's
+    static-shape DMAs may over-read."""
+    cache = getattr(plan, "_view_layouts", None)
+    if cache is None:
+        cache = plan._view_layouts = {}
+    vl = cache.get(mode)
+    if vl is not None:
+        return vl
+    from ..ops.ffa_kernel import DMA_CHUNK, WIRE_MODES, _prcap
+    from ..ops.slottables import container_rows
+
+    group, planes = WIRE_MODES[mode]
+    PW = _view_width(plan)
+    r0s = [-(-st.n // PW) for st in plan.stages]
+    prs = [-(-r0 // group) for r0 in r0s]
+    wrows = [planes * pr for pr in prs]
+    roffs = np.concatenate([[0], np.cumsum(wrows)]).astype(np.int64)
+    soffs = np.concatenate([[0], np.cumsum(r0s)]).astype(np.int64)
+    # Scale-DMA extent bound: the kernel reads group * _prcap(rows)
+    # scale rows per stage; bound rows by the stage's full-bucket
+    # container (lane-split buckets are never taller). The 2^L form is
+    # the bound even when base-3 containers are in use — the env knob
+    # RIPTIDE_KERNEL_BASE3 may differ between prepare and queue time,
+    # and an under-sized slack would let the clamped DMA start
+    # misalign the last stage's real scale rows.
+    sslack = DMA_CHUNK * group
+    for st in plan.stages:
+        rows = max(container_rows(max(st.ms_padded), st.kernel_depth),
+                   1 << st.kernel_depth)
+        sslack = max(sslack, group * _prcap(rows, group))
+    vl = cache[mode] = {
+        "PW": PW, "group": group, "planes": planes,
+        "r0s": r0s, "prs": prs, "wrows": wrows,
+        "roffs": roffs[:-1], "tot_rows": int(roffs[-1]) + DMA_CHUNK,
+        "soffs": soffs[:-1], "stot": int(soffs[-1]) + int(sslack),
+    }
+    return vl
 
 
 def _wire_layout(plan, mode):
-    """Per-stage (offsets, lengths, total) of the flat wire buffer, in
-    the mode's storage unit: BYTES for 'uint12' (each stage 3 bytes per
-    sample pair, odd sample counts padded by one), 'uint8' (one byte
-    per sample, stages padded to whole BLKQ blocks) and 'uint6' (three
-    bytes per four samples, whole BLKQ blocks), ELEMENTS otherwise."""
-    if mode == "uint12":
-        lens = [3 * ((st.n + 1) // 2) for st in plan.stages]
-    elif mode == "uint8":
-        lens = [BLKQ * (-(-st.n // BLKQ)) for st in plan.stages]
-    elif mode == "uint6":
-        lens = [(BLKQ // 4) * 3 * (-(-st.n // BLKQ)) for st in plan.stages]
-    else:
-        lens = [st.n for st in plan.stages]
+    """Per-stage (offsets, lengths, total) of the wire buffer: ELEMENTS
+    of the flat (D, total) sample buffer for float modes, WIRE ROWS of
+    the (D, total, PW) byte-plane view for quantised modes."""
+    if mode in _WIRE_Q:
+        vl = _view_layout(plan, mode)
+        return vl["roffs"], vl["wrows"], vl["tot_rows"]
+    lens = [st.n for st in plan.stages]
     offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
     return offs[:-1], lens, int(offs[-1])
 
 
-def _scale_layout(plan):
-    """uint8 wire: per-stage offsets into the flat (D, total_blocks)
-    block-scale array."""
-    nblks = [-(-st.n // BLKQ) for st in plan.stages]
-    soffs = np.concatenate([[0], np.cumsum(nblks)]).astype(np.int64)
-    return soffs[:-1], nblks, int(soffs[-1])
+def _udecode_view(mode, seg, scales):
+    """Decode one stage's byte planes: ``seg`` (..., planes * pr, PW)
+    uint8 + ``scales`` (..., r0, 1) float32 -> (..., r0, PW) float32
+    sample view. The operation sequence (int bit ops, cast, subtract,
+    multiply) is EXACTLY the fused kernel prologue's, so the XLA pack
+    path and the fused kernel produce bit-identical containers."""
+    lead = seg.shape[:-2]
+    PW = seg.shape[-1]
+    r0 = scales.shape[-2]
+    if mode == "uint8":
+        xq = seg.astype(jnp.float32) - 128.0
+    else:
+        pr = seg.shape[-2] // 3
+        pl3 = seg.reshape(lead + (3, pr, PW))
+        b0 = pl3[..., 0, :, :].astype(jnp.int32)
+        b1 = pl3[..., 1, :, :].astype(jnp.int32)
+        b2 = pl3[..., 2, :, :].astype(jnp.int32)
+        if mode == "uint6":
+            word = b0 | (b1 << 8) | (b2 << 16)
+            qs = [((word >> (6 * j)) & 63).astype(jnp.float32) - 32.0
+                  for j in range(4)]
+        else:  # uint12
+            qs = [(b0 | ((b1 & 15) << 8)).astype(jnp.float32) - 2048.0,
+                  ((b1 >> 4) | (b2 << 4)).astype(jnp.float32) - 2048.0]
+        xq = jnp.stack(qs, axis=-2).reshape(lead + (len(qs) * pr, PW))
+    return xq[..., :r0, :] * scales
 
 
-def _u12_decode(seg, scale):
-    """(..., nb) uint8 wire bytes -> (..., 2 * nb // 3) float32 samples.
-    Inverse of the packing in native rn_prepare_wire_u12."""
-    lead = seg.shape[:-1]
-    nb = seg.shape[-1]
-    trip = seg.reshape(lead + (nb // 3, 3)).astype(jnp.int32)
-    b0, b1, b2 = trip[..., 0], trip[..., 1], trip[..., 2]
-    q = jnp.stack([b0 | ((b1 & 15) << 8), (b1 >> 4) | (b2 << 4)], axis=-1)
-    q = q.reshape(lead + (2 * (nb // 3),))
-    return (q.astype(jnp.float32) - 2048.0) * scale[..., None]
+def _decode_stage_rows(mode, wire, scales, roff, nrows, soff, r0, n):
+    """Slice + decode ONE stage's samples out of the (..., WROWS, PW)
+    wire view and (..., STOT, 1) scales: the device-side inverse of
+    :func:`_prepare_uint`, traceable anywhere (plain ops, no jit) so
+    the sharded path runs it INSIDE shard_map. Returns (..., n) f32."""
+    seg = jax.lax.slice_in_dim(wire, roff, roff + nrows, axis=-2)
+    sc = jax.lax.slice_in_dim(scales, soff, soff + r0, axis=-2)
+    xv = _udecode_view(mode, seg, sc)
+    return xv.reshape(xv.shape[:-2] + (r0 * xv.shape[-1],))[..., :n]
 
 
-@cached_jit(static_argnames=("off", "nb", "n", "shapes", "rows", "P"))
-def _pack_static_u12(flat, scale, off, nb, n, shapes, rows, P):
-    """uint12 counterpart of :func:`_pack_static`: slice nb wire bytes,
-    decode to float32 with the stage's per-trial scales, then the same
-    per-problem reshape + zero-pad. One dispatch per stage."""
-    xd = _slice_decode("uint12", flat, scale, off, nb, 0, 0, n)
+@cached_jit(static_argnames=("mode", "roff", "nrows", "soff", "r0", "n",
+                             "shapes", "rows", "P"))
+def _pack_static_view(wire, scales, mode, roff, nrows, soff, r0, n,
+                      shapes, rows, P):
+    """Two-dispatch fallback for quantised wires on the kernel path
+    (stages the fused program cannot serve, e.g. VMEM-overflow depths):
+    decode + per-problem reshape + zero-pad as ONE XLA program."""
+    xd = _decode_stage_rows(mode, wire, scales, roff, nrows, soff, r0, n)
     return _pack_container(xd, shapes, rows, P)
 
 
-@cached_jit(static_argnames=("off", "nb", "n", "nout"))
-def _unpack_u12_padded(flat, scale, off, nb, n, nout):
-    """Gather-path uint12 unpack: decode one stage's samples and
-    zero-pad to the plan-wide padded length."""
-    xd = _slice_decode("uint12", flat, scale, off, nb, 0, 0, n)
-    return jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
-
-
-def _u8_decode(seg, scaleseg):
-    """(..., nblk * BLKQ) uint8 wire bytes + (..., nblk) block scales ->
-    (..., nblk * BLKQ) float32 samples."""
-    lead = seg.shape[:-1]
-    nblk = seg.shape[-1] // BLKQ
-    q = seg.reshape(lead + (nblk, BLKQ)).astype(jnp.float32) - 128.0
-    return (q * scaleseg[..., None]).reshape(lead + (nblk * BLKQ,))
-
-
-@cached_jit(static_argnames=("off", "nb", "soff", "nblk", "n", "shapes",
-                             "rows", "P"))
-def _pack_static_u8(flat, scales, off, nb, soff, nblk, n, shapes, rows, P):
-    """uint8 counterpart of :func:`_pack_static`: slice nb wire bytes
-    and the stage's block scales, decode, then the per-problem reshape +
-    zero-pad. One dispatch per stage."""
-    xd = _slice_decode("uint8", flat, scales, off, nb, soff, nblk, n)
-    return _pack_container(xd, shapes, rows, P)
-
-
-@cached_jit(static_argnames=("off", "nb", "soff", "nblk", "n", "nout"))
-def _unpack_u8_padded(flat, scales, off, nb, soff, nblk, n, nout):
-    """Gather-path uint8 unpack: decode one stage's samples and
-    zero-pad to the plan-wide padded length."""
-    xd = _slice_decode("uint8", flat, scales, off, nb, soff, nblk, n)
-    return jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
-
-
-def _u6_decode(seg, scaleseg):
-    """(..., nblk * BLKQ * 3 // 4) uint8 wire bytes + (..., nblk) block
-    scales -> (..., nblk * BLKQ) float32 samples. Inverse of the packing
-    in native rn_prepare_wire_u6 (q0 | q1<<6 | q2<<12 | q3<<18)."""
-    lead = seg.shape[:-1]
-    nblk = seg.shape[-1] // (BLKQ // 4 * 3)
-    trip = seg.reshape(lead + (nblk * BLKQ // 4, 3)).astype(jnp.int32)
-    word = trip[..., 0] | (trip[..., 1] << 8) | (trip[..., 2] << 16)
-    q = jnp.stack([(word >> (6 * j)) & 63 for j in range(4)], axis=-1)
-    q = q.reshape(lead + (nblk, BLKQ)).astype(jnp.float32) - 32.0
-    return (q * scaleseg[..., None]).reshape(lead + (nblk * BLKQ,))
-
-
-@cached_jit(static_argnames=("off", "nb", "soff", "nblk", "n", "shapes",
-                             "rows", "P"))
-def _pack_static_u6(flat, scales, off, nb, soff, nblk, n, shapes, rows, P):
-    """uint6 counterpart of :func:`_pack_static_u8`."""
-    xd = _slice_decode("uint6", flat, scales, off, nb, soff, nblk, n)
-    return _pack_container(xd, shapes, rows, P)
-
-
-@cached_jit(static_argnames=("off", "nb", "soff", "nblk", "n", "nout"))
-def _unpack_u6_padded(flat, scales, off, nb, soff, nblk, n, nout):
-    """Gather-path uint6 unpack: decode one stage's samples and
-    zero-pad to the plan-wide padded length."""
-    xd = _slice_decode("uint6", flat, scales, off, nb, soff, nblk, n)
+@cached_jit(static_argnames=("mode", "roff", "nrows", "soff", "r0", "n",
+                             "nout"))
+def _unpack_view_padded(wire, scales, mode, roff, nrows, soff, r0, n, nout):
+    """Gather-path unpack of a quantised wire stage: decode and zero-pad
+    to the plan-wide padded length."""
+    xd = _decode_stage_rows(mode, wire, scales, roff, nrows, soff, r0, n)
     return jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
 
 
 def _stage_unpack(meta, i, flat, scales, n, nout=None):
-    """Stage ``i``'s :func:`_slice_decode` driven by the wire meta;
-    traceable anywhere (plain ops, no jit) so the sharded path can run
-    it INSIDE ``shard_map`` on each dm shard. ``flat``/``scales`` may
-    carry any leading batch dims. Returns (..., n) float32, zero-padded
-    to ``nout`` when given."""
+    """Stage ``i``'s wire decode driven by the wire meta; traceable
+    anywhere (plain ops, no jit) so the sharded path can run it INSIDE
+    ``shard_map`` on each dm shard. ``flat``/``scales`` may carry any
+    leading batch dims. Returns (..., n) float32, zero-padded to
+    ``nout`` when given."""
     mode = meta["mode"]
-    if mode in ("uint6", "uint8"):
-        soff, nblk = int(meta["soffs"][i]), int(meta["nblks"][i])
+    if mode in _WIRE_Q:
+        vl = meta["view"]
+        xd = _decode_stage_rows(
+            mode, flat, scales, int(vl["roffs"][i]), int(vl["wrows"][i]),
+            int(vl["soffs"][i]), int(vl["r0s"][i]), n,
+        )
     else:
-        soff, nblk = 0, 0
-        if mode == "uint12":
-            scales = scales[i]
-    xd = _slice_decode(mode, flat, scales,
-                       int(meta["offs"][i]), int(meta["lens"][i]),
-                       soff, nblk, n)
+        xd = _slice_decode_float(flat, int(meta["offs"][i]), n)
     if nout is not None and nout > n:
         xd = jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(0, nout - n)])
     return xd
 
 
-def _prepare_u6(plan, batch):
-    """6-bit block-adaptive wire preparation: native single-pass when
-    available, vectorised numpy otherwise (bit-identical to native).
-    Returns (wire (D, totbytes) uint8, scales (D, total_blocks) f32)."""
+def _prepare_uint(plan, batch, mode):
+    """Quantised wire preparation in the kernel-decodable byte-plane
+    view (:func:`_view_layout`): native single-pass when available,
+    vectorised numpy otherwise (bit-identical — same float64
+    downsampling, same float32 reciprocal, same round-half-even).
+    Returns (wire (D, tot_rows, PW) uint8, scales (D, stot) f32)."""
     from .. import native
 
-    offs, lens, tot = _wire_layout(plan, "uint6")
-    soffs, nblks, stot = _scale_layout(plan)
+    vl = _view_layout(plan, mode)
+    qmax, bias = _WIRE_Q[mode]
+    group, PW = vl["group"], vl["PW"]
+    D = batch.shape[0]
     if native.available():
         imin, imax, wmin, wmax, wint = _ds_pack(plan)
         nouts = np.asarray([st.n for st in plan.stages], np.int32)
-        return native.prepare_wire_u6(
-            batch, imin, imax, wmin, wmax, wint, nouts, offs, tot,
-            soffs, stot, blkq=BLKQ,
+        return native.prepare_wire_view(
+            batch, imin, imax, wmin, wmax, wint, nouts, mode, PW,
+            vl["roffs"], vl["tot_rows"], vl["soffs"], vl["stot"],
         )
     d64, c32, anchors = _prefix_anchored(batch)
-    D = batch.shape[0]
-    out = np.zeros((D, tot), np.uint8)
-    scales = np.empty((D, stot), np.float32)
+    out = np.zeros((D, vl["tot_rows"], PW), np.uint8)
+    # Slack scale rows stay 1.0 (finite) so DMA over-reads past the
+    # last stage can never inject non-finite values.
+    scales = np.ones((D, vl["stot"]), np.float32)
     for i, st in enumerate(plan.stages):
         xd = _stage_downsample(st, d64, c32, anchors)[..., : st.n]
-        nblk = nblks[i]
-        pad = nblk * BLKQ - st.n
-        if pad:
-            xd = np.concatenate([xd, np.zeros((D, pad), np.float32)], axis=1)
-        blocks = xd.reshape(D, nblk, BLKQ)
-        bmax = np.abs(blocks).max(axis=2)
-        s = np.where(bmax > 0, bmax / 31.0, 1.0).astype(np.float32)
-        scales[:, soffs[i] : soffs[i] + nblk] = s
+        r0, pr, roff, soff = (vl["r0s"][i], vl["prs"][i],
+                              int(vl["roffs"][i]), int(vl["soffs"][i]))
+        buf = np.zeros((D, group * pr * PW), np.float32)
+        buf[:, : st.n] = xd
+        view = buf.reshape(D, group * pr, PW)
+        rmax = np.abs(view[:, :r0]).max(axis=2)
+        s = np.where(rmax > 0, rmax / np.float32(qmax),
+                     np.float32(1.0)).astype(np.float32)
+        scales[:, soff : soff + r0] = s
         inv = (np.float32(1.0) / s).astype(np.float32)
-        q = (np.rint(blocks * inv[:, :, None]).astype(np.int32) + 32) & 63
-        quad = q.reshape(D, nblk * BLKQ // 4, 4)
-        word = (quad[..., 0] | (quad[..., 1] << 6) | (quad[..., 2] << 12)
-                | (quad[..., 3] << 18))
-        tmp = np.empty((D, word.shape[1], 3), np.uint8)
-        tmp[..., 0] = word & 255
-        tmp[..., 1] = (word >> 8) & 255
-        tmp[..., 2] = (word >> 16) & 255
-        out[:, offs[i] : offs[i] + lens[i]] = tmp.reshape(D, lens[i])
-    return out, scales
-
-
-def _prepare_u8(plan, batch):
-    """8-bit block-adaptive wire preparation: native single-pass when
-    available, vectorised numpy otherwise. Returns
-    (wire (D, totbytes) uint8, scales (D, total_blocks) float32)."""
-    from .. import native
-
-    offs, lens, tot = _wire_layout(plan, "uint8")
-    soffs, nblks, stot = _scale_layout(plan)
-    if native.available():
-        imin, imax, wmin, wmax, wint = _ds_pack(plan)
-        nouts = np.asarray([st.n for st in plan.stages], np.int32)
-        return native.prepare_wire_u8(
-            batch, imin, imax, wmin, wmax, wint, nouts, offs, tot,
-            soffs, stot, blkq=BLKQ,
-        )
-    d64, c32, anchors = _prefix_anchored(batch)
-    D = batch.shape[0]
-    out = np.zeros((D, tot), np.uint8)
-    scales = np.empty((D, stot), np.float32)
-    for i, st in enumerate(plan.stages):
-        xd = _stage_downsample(st, d64, c32, anchors)[..., : st.n]
-        nblk = nblks[i]
-        pad = nblk * BLKQ - st.n
-        if pad:
-            xd = np.concatenate([xd, np.zeros((D, pad), np.float32)], axis=1)
-        blocks = xd.reshape(D, nblk, BLKQ)
-        bmax = np.abs(blocks).max(axis=2)
-        s = np.where(bmax > 0, bmax / 127.0, 1.0).astype(np.float32)
-        scales[:, soffs[i] : soffs[i] + nblk] = s
-        inv = (np.float32(1.0) / s).astype(np.float32)
-        q = np.rint(blocks * inv[:, :, None]).astype(np.int32) + 128
-        out[:, offs[i] : offs[i] + lens[i]] = (
-            (q & 255).astype(np.uint8).reshape(D, lens[i])
-        )
-    return out, scales
-
-
-def _prepare_u12(plan, batch):
-    """12-bit wire preparation: native single-pass when available,
-    vectorised numpy otherwise. Returns (wire (D, totbytes) uint8,
-    scales (S, D) float32)."""
-    from .. import native
-
-    offs, lens, tot = _wire_layout(plan, "uint12")
-    if native.available():
-        imin, imax, wmin, wmax, wint = _ds_pack(plan)
-        nouts = np.asarray([st.n for st in plan.stages], np.int32)
-        return native.prepare_wire_u12(
-            batch, imin, imax, wmin, wmax, wint, nouts, offs, tot
-        )
-    d64, c32, anchors = _prefix_anchored(batch)
-    D = batch.shape[0]
-    out = np.zeros((D, tot), np.uint8)
-    scales = np.empty((len(plan.stages), D), np.float32)
-    for i, st in enumerate(plan.stages):
-        xd = _stage_downsample(st, d64, c32, anchors)[..., : st.n]
-        vmax = np.abs(xd).max(axis=1)
-        s = np.where(vmax > 0, vmax / 2047.0, 1.0).astype(np.float32)
-        scales[i] = s
-        # Multiply by the float32 reciprocal exactly like the native
-        # path (rn_prepare_wire_u12) so both produce identical bytes.
-        inv = (np.float32(1.0) / s).astype(np.float32)
-        q = np.rint(xd * inv[:, None]).astype(np.int32) + 2048
-        if st.n % 2:
-            q = np.concatenate([q, np.full((D, 1), 2048, np.int32)], axis=1)
-        q0, q1 = q[:, 0::2], q[:, 1::2]
-        tmp = np.empty((D, q0.shape[1], 3), np.uint8)
-        tmp[..., 0] = q0 & 255
-        tmp[..., 1] = ((q0 >> 8) & 15) | ((q1 & 15) << 4)
-        tmp[..., 2] = (q1 >> 4) & 255
-        out[:, offs[i] : offs[i] + lens[i]] = tmp.reshape(D, lens[i])
+        q = np.full((D, group * pr, PW), bias, np.int32)
+        q[:, :r0] = (np.rint(view[:, :r0] * inv[:, :, None]).astype(np.int32)
+                     + bias) & (2 * bias - 1)
+        if mode == "uint8":
+            out[:, roff : roff + pr] = (q & 255).astype(np.uint8)
+            continue
+        qg = q.reshape(D, pr, group, PW)
+        if mode == "uint6":
+            word = (qg[:, :, 0] | (qg[:, :, 1] << 6) | (qg[:, :, 2] << 12)
+                    | (qg[:, :, 3] << 18))
+        else:  # uint12
+            word = qg[:, :, 0] | (qg[:, :, 1] << 12)
+        out[:, roff : roff + pr] = (word & 255).astype(np.uint8)
+        out[:, roff + pr : roff + 2 * pr] = ((word >> 8) & 255).astype(np.uint8)
+        out[:, roff + 2 * pr : roff + 3 * pr] = (
+            (word >> 16) & 255).astype(np.uint8)
     return out, scales
 
 
@@ -603,24 +551,35 @@ def _ffa_path():
     return "kernel" if tpu else "gather"
 
 
-def _kernel_eligible(st, plan):
-    """The fused Pallas kernel serves a stage when its packed-word layout
-    fits (p <= PH_MASK = 2047), the width ladder fits the coefficient
-    bank, the container is at least one sublane tile, and the streaming
-    working set fits the kernel's own VMEM budget (the same
-    ``kernel_vmem_bytes`` the kernel's CompilerParams limit derives
-    from, so the two cannot drift apart). Ineligible stages fall back to
-    the gather path per stage."""
-    from ..ops.ffa_kernel import PH_MASK, VMEM_LIMIT, kernel_vmem_bytes
+def _bucket_shape(st, idx):
+    """(L, NL, rows, P) of one lane bucket's kernel container, computed
+    WITHOUT building the kernel (for eligibility checks)."""
+    from ..ops.plan import num_levels
     from ..ops.slottables import NAT_LEVELS, container_rows
 
-    L = st.kernel_depth
+    ms = [st.ms_padded[i] for i in idx]
+    ps = [st.ps_padded[i] for i in idx]
+    L = max(num_levels(m) for m in ms)
     NL = min(L, NAT_LEVELS)
     if os.environ.get("RIPTIDE_KERNEL_BASE3") == "0":
         rows = 1 << L
     else:
-        rows = container_rows(max(st.ms_padded), L)
-    P = -(-max(st.ps_padded) // 128) * 128
+        rows = container_rows(max(ms), L)
+    P = -(-max(ps) // 128) * 128
+    return L, NL, rows, P
+
+
+def _kernel_eligible(st, plan):
+    """The Pallas cycle kernel serves a stage when its packed-word
+    layout fits (p <= PH_MASK = 2047), the width ladder fits the
+    coefficient bank, the container is at least one sublane tile, and
+    the streaming working set fits the kernel's own VMEM budget (the
+    same ``kernel_vmem_bytes`` the kernel's CompilerParams limit
+    derives from, so the two cannot drift apart). Ineligible stages
+    fall back to the gather path per stage."""
+    from ..ops.ffa_kernel import PH_MASK, VMEM_LIMIT, kernel_vmem_bytes
+
+    L, NL, rows, P = _bucket_shape(st, range(len(st.ms_padded)))
     return (
         st.kernel_depth >= 3
         and max(st.ps_padded) <= PH_MASK
@@ -629,33 +588,105 @@ def _kernel_eligible(st, plan):
     )
 
 
+def _fused_eligible(st, plan, mode):
+    """Whether the stage runs as FUSED single-dispatch programs (wire
+    decode + dequant + pack + FFA + S/N in one Pallas call per lane
+    bucket): quantised wire, kernel-eligible, and every lane bucket's
+    working set — including the decode/pack scratch — inside the VMEM
+    budget. Stages failing only the fused budget fall back to the
+    two-dispatch XLA-pack + kernel form, not to the gather path."""
+    from ..ops.ffa_kernel import (PH_MASK, VMEM_LIMIT, WIRE_MODES,
+                                  kernel_vmem_bytes)
+
+    if mode not in WIRE_MODES or not _kernel_eligible(st, plan):
+        return False
+    PW = _view_width(plan)
+    if PW > (1 << 11):  # pack-word r field width (PK_R_BITS)
+        return False
+    for idx in st.lane_buckets:
+        L, NL, rows, P = _bucket_shape(st, idx)
+        if max(st.ps_padded[i] for i in idx) > PH_MASK:
+            return False
+        if kernel_vmem_bytes(L, NL, rows, P, False, fused_mode=mode,
+                             PW=PW) >= VMEM_LIMIT:
+            return False
+    return True
+
+
+def _count_dispatch(kind, n=1):
+    """Device-program launch accounting (metrics counters
+    ``dispatch_<kind>``): the regression tests assert the fused path
+    queues exactly one device program per eligible stage lane bucket
+    and zero separate pack programs."""
+    get_metrics().add(f"dispatch_{kind}", n)
+
+
+def _stagevec(st, vl, i, roff, mode):
+    """(1, 8) int32 device stage vector of the fused call: [wire row
+    offset (part-relative), plane rows, scale row offset, view rows,
+    0...]; cached on the stage per (mode, part offset)."""
+    cache = getattr(st, "_stagevecs", None)
+    if cache is None:
+        cache = st._stagevecs = {}
+    key = (mode, i, roff)
+    sv = cache.get(key)
+    if sv is None:
+        sv = cache[key] = jnp.asarray(np.asarray(
+            [[roff, vl["prs"][i], vl["soffs"][i], vl["r0s"][i],
+              0, 0, 0, 0]], np.int32))
+    return sv
+
+
+def _run_stage_fused(st, wire_part, roff, plan, meta, i):
+    """Queue one FUSED cascade stage: one Pallas program per lane
+    bucket doing wire decode + dequant + (m, p) pack + FFA + S/N — the
+    former per-stage XLA pack program (and its (D, B, rows, P) f32
+    container round-trip through HBM) is gone. Returns a tuple of
+    per-bucket (..., B_k, rows_eval_max_k, NW) containers unsynced,
+    each sliced immediately so the raw (B_k, RS, 128) output can be
+    freed before assembly."""
+    interpret = jax.default_backend() == "cpu"
+    vl = meta["view"]
+    nw = len(plan.widths)
+    nre = len(st.rows_eval)
+    sv = _stagevec(st, vl, i, roff, meta["mode"])
+    outs = []
+    for idx, kern in st.cycle_kernels(interpret=interpret):
+        out = kern.run_fused(sv, wire_part, meta["scales_dev"],
+                             meta["mode"])
+        _count_dispatch("fused")
+        remax = max([st.rows_eval[g] for g in idx if g < nre] or [0])
+        outs.append(out[..., : max(remax, 1), :nw])
+        _count_dispatch("slice")
+    return tuple(outs)
+
+
 def _run_stage_kernel(st, flat_dev, off, plan, meta, i):
-    """Queue one kernel-path cascade stage from the shipped wire buffer;
-    returns the (..., B, rows_eval_max, NW) S/N container unsynced. The
-    raw (B, RS, 128) kernel output is sliced immediately so it can be
-    freed — keeping every stage's raw container alive until assembly
-    costs ~170 MB x stages of HBM and OOMs large DM batches."""
+    """Queue one TWO-dispatch kernel-path cascade stage from the
+    shipped wire buffer (float wires, and quantised stages the fused
+    program cannot serve): XLA decode+pack program, then the Pallas
+    call. Returns the (..., B, rows_eval_max, NW) S/N container
+    unsynced. The raw (B, RS, 128) kernel output is sliced immediately
+    so it can be freed — keeping every stage's raw container alive
+    until assembly costs ~170 MB x stages of HBM and OOMs large DM
+    batches."""
     interpret = jax.default_backend() == "cpu"
     kern = st.cycle_kernel(interpret=interpret)
     shapes = tuple(zip(st.ms_padded, st.ps_padded))
-    if meta["mode"] == "uint8":
-        soffs, nblks = meta["soffs"], meta["nblks"]
-        x = _pack_static_u8(flat_dev, meta["scales_dev"], off,
-                            meta["lens"][i], int(soffs[i]), nblks[i],
-                            st.n, shapes, kern.rows, kern.P)
-    elif meta["mode"] == "uint6":
-        soffs, nblks = meta["soffs"], meta["nblks"]
-        x = _pack_static_u6(flat_dev, meta["scales_dev"], off,
-                            meta["lens"][i], int(soffs[i]), nblks[i],
-                            st.n, shapes, kern.rows, kern.P)
-    elif meta["mode"] == "uint12":
-        x = _pack_static_u12(flat_dev, meta["scales_dev"][i], off,
-                             meta["lens"][i], st.n, shapes,
-                             kern.rows, kern.P)
+    if meta["mode"] in _WIRE_Q:
+        vl = meta["view"]
+        x = _pack_static_view(flat_dev, meta["scales_dev"], meta["mode"],
+                              off, vl["wrows"][i], int(vl["soffs"][i]),
+                              vl["r0s"][i], st.n, shapes, kern.rows,
+                              kern.P)
     else:
         x = _pack_static(flat_dev, off, st.n, shapes, kern.rows, kern.P)
+    _count_dispatch("pack")
     out = kern(x)
-    return out[..., : max(st.rows_eval_max, 1), : len(plan.widths)]
+    _count_dispatch("kernel")
+    out = out[..., : max(st.rows_eval_max, 1), : len(plan.widths)]
+    _count_dispatch("slice")
+    return out
 
 
 def _run_stage_gather(st, xd_dev, plan):
@@ -709,19 +740,30 @@ def _assemble(plan, raw_per_stage):
     return np.empty((0, nw), np.float32)
 
 
-@cached_jit(static_argnames=("plan",))
-def _assemble_device(plan, *outs):
+@cached_jit(static_argnames=("plan", "layout"))
+def _assemble_device(plan, layout, *outs):
     """Device-side counterpart of :func:`_assemble`: slice every stage's
     evaluated rows and concatenate in plan trial order, keeping the
     (D, n_trials, NW) S/N cube on the device (for on-device peak
-    detection — only KB-sized peak summaries then cross to the host)."""
+    detection — only KB-sized peak summaries then cross to the host).
+    ``outs[s]`` is a tuple of that stage's per-lane-bucket containers
+    (a 1-tuple on the unsplit paths); ``layout[s]`` names each bucket's
+    original problem indices (None for a single full-batch bucket) so
+    the concatenation preserves the reference's (cycle, bins, shift)
+    trial order."""
     nw = len(plan.widths)
     chunks = []
-    for st, raw in zip(plan.stages, outs):
+    for st, raws, buckets in zip(plan.stages, outs, layout):
+        if buckets is None:
+            pos = {i: (0, i) for i in range(len(st.rows_eval))}
+        else:
+            pos = {g: (k, j) for k, idx in enumerate(buckets)
+                   for j, g in enumerate(idx)}
         for i, re in enumerate(st.rows_eval):
             if re:
-                # raw: kernel (D, B, RS, 128) or gather (D, B, R, NW)
-                chunks.append(raw[:, i, :re, :nw])
+                k, j = pos[i]
+                # raws[k]: kernel (D, Bk, RS, 128) or gather (D, B, R, NW)
+                chunks.append(raws[k][:, j, :re, :nw])
     return jnp.concatenate(chunks, axis=1)
 
 
@@ -748,12 +790,10 @@ def prepare_stage_data(plan, batch, mode=None):
     mode = mode or _wire_mode(path)
     offs, lens, tot = _wire_layout(plan, mode)
     scales = None
-    if mode == "uint8":
-        flat, scales = _prepare_u8(plan, batch)
-    elif mode == "uint6":
-        flat, scales = _prepare_u6(plan, batch)
-    elif mode == "uint12":
-        flat, scales = _prepare_u12(plan, batch)
+    if mode in _WIRE_Q:
+        flat, scales = _prepare_uint(plan, batch, mode)
+        meta = {"path": path, "mode": mode, "offs": offs, "lens": lens,
+                "scales": scales, "view": _view_layout(plan, mode)}
     else:
         wire = np.dtype(mode)
         xds = _host_downsample_all(plan, batch, wire)
@@ -761,39 +801,63 @@ def prepare_stage_data(plan, batch, mode=None):
         flat = np.empty((D, tot), wire)
         for i, st in enumerate(plan.stages):
             flat[:, offs[i] : offs[i] + st.n] = xds[i][..., : st.n]
-    meta = {"path": path, "mode": mode, "offs": offs, "lens": lens,
-            "scales": scales}
+        meta = {"path": path, "mode": mode, "offs": offs, "lens": lens,
+                "scales": None}
     get_metrics().observe("prep_s", time.perf_counter() - t0)
     return flat, meta
+
+
+def _wire_parts(plan, mode):
+    """The shipped wire's part split, in the mode's storage unit
+    (elements for float wires, rows for byte-plane views): list of
+    ``(start, end, [(stage index, part-relative offset), ...])`` for up
+    to 4 parts cut at stage boundaries. View parts carry a DMA_CHUNK
+    tail slack for the fused kernel's chunked plane over-reads. The
+    SINGLE definition of the split — ship_stage_data slices by it and
+    warm_stage_kernels keys the fused builds on its shapes, so the two
+    cannot drift (a mismatch would silently miss every warmed
+    executable)."""
+    from ..ops.ffa_kernel import DMA_CHUNK
+
+    offs, lens, tot = _wire_layout(plan, mode)
+    S = len(plan.stages)
+    starts = np.concatenate([offs, [offs[-1] + lens[-1]]])
+    nchunks = min(4, S)
+    bounds = [int(round(i * S / nchunks)) for i in range(nchunks + 1)]
+    parts = []
+    for a, b in zip(bounds, bounds[1:]):
+        start, end = int(starts[a]), int(starts[b])
+        if mode in _WIRE_Q:
+            end = min(end + DMA_CHUNK, tot)
+        parts.append((start, end,
+                      [(i, int(starts[i]) - start) for i in range(a, b)]))
+    return parts
 
 
 def ship_stage_data(plan, prepared):
     """Asynchronously ship a prepared wire buffer to the device, in up
     to 4 chunks cut at stage boundaries (each stage's data lives wholly
     inside one chunk, so early stages can start while later chunks are
-    in flight). Returns the device parts + stage->(part, offset) map;
-    pass to :func:`run_search_batch` as ``shipped`` to start the next
-    batch's transfer while the current one computes."""
+    in flight; see :func:`_wire_parts`). Returns the device parts +
+    stage->(part, offset) map; pass to :func:`run_search_batch` as
+    ``shipped`` to start the next batch's transfer while the current
+    one computes."""
     flat, meta = prepared
     t0 = time.perf_counter()
-    S = len(plan.stages)
-    starts = np.concatenate(
-        [meta["offs"], [meta["offs"][-1] + meta["lens"][-1]]]
-    )
-    nchunks = min(4, S)
-    bounds = [int(round(i * S / nchunks)) for i in range(nchunks + 1)]
     parts = []
     part_of = {}
-    for c, (a, b) in enumerate(zip(bounds, bounds[1:])):
-        parts.append(jnp.asarray(flat[..., int(starts[a]) : int(starts[b])]))
-        for i in range(a, b):
-            part_of[i] = (c, int(starts[i] - starts[a]))
+    for c, (start, end, stages) in enumerate(_wire_parts(plan,
+                                                         meta["mode"])):
+        # Both layouts split on axis 1 (elements of the flat float
+        # buffer / rows of the byte-plane view).
+        parts.append(jnp.asarray(flat[:, start:end]))
+        for i, off in stages:
+            part_of[i] = (c, off)
     meta = dict(meta)
     if meta["scales"] is not None:
-        meta["scales_dev"] = jnp.asarray(meta["scales"])
-    if meta["mode"] in ("uint8", "uint6"):
-        soffs, nblks, _ = _scale_layout(plan)
-        meta["soffs"], meta["nblks"] = soffs, nblks
+        # (D, STOT, 1): the trailing unit axis gives the fused kernel's
+        # per-row scale DMA a 2-D (R0, 1) destination.
+        meta["scales_dev"] = jnp.asarray(meta["scales"][..., None])
     reg = get_metrics()
     reg.observe("wire_s", time.perf_counter() - t0)
     reg.add("wire_bytes", int(flat.nbytes))
@@ -803,8 +867,13 @@ def ship_stage_data(plan, prepared):
 def _queue_stages(plan, batch, prepared=None, shipped=None):
     """Queue every cascade stage on device, from (in order of
     precedence) already-shipped device parts, a prepared host wire
-    buffer, or the raw batch. Each stage runs as two dispatches (fused
-    slice+unpack+pack, kernel)."""
+    buffer, or the raw batch. Quantised wires on the kernel path run
+    each eligible stage as ONE fused device dispatch per lane bucket
+    (wire decode + pack + FFA + S/N in a single Pallas program);
+    everything else keeps its previous form. Returns (outs, layout):
+    ``outs[s]`` is the stage's tuple of queued containers and
+    ``layout[s]`` its lane-bucket index map (None when unsplit) for
+    :func:`_assemble_device`."""
     if shipped is None:
         if prepared is None:
             prepared = prepare_stage_data(plan, batch)
@@ -813,24 +882,27 @@ def _queue_stages(plan, batch, prepared=None, shipped=None):
     path, mode = meta["path"], meta["mode"]
 
     outs = []
+    layout = []
     for i, st in enumerate(plan.stages):
         c, off = part_of[i]
+        if path == "kernel" and _fused_eligible(st, plan, mode):
+            buckets = st.lane_buckets
+            outs.append(_run_stage_fused(st, parts[c], off, plan, meta, i))
+            layout.append(buckets if len(buckets) > 1 else None)
+            continue
+        layout.append(None)
         if path == "kernel" and _kernel_eligible(st, plan):
-            outs.append(_run_stage_kernel(st, parts[c], off, plan, meta, i))
-        elif mode == "uint8":
-            xd = _unpack_u8_padded(parts[c], meta["scales_dev"], off,
-                                   meta["lens"][i], int(meta["soffs"][i]),
-                                   meta["nblks"][i], st.n, plan.nout)
-            outs.append(_run_stage_gather(st, xd, plan))
-        elif mode == "uint6":
-            xd = _unpack_u6_padded(parts[c], meta["scales_dev"], off,
-                                   meta["lens"][i], int(meta["soffs"][i]),
-                                   meta["nblks"][i], st.n, plan.nout)
-            outs.append(_run_stage_gather(st, xd, plan))
-        elif mode == "uint12":
-            xd = _unpack_u12_padded(parts[c], meta["scales_dev"][i], off,
-                                    meta["lens"][i], st.n, plan.nout)
-            outs.append(_run_stage_gather(st, xd, plan))
+            outs.append((_run_stage_kernel(st, parts[c], off, plan, meta,
+                                           i),))
+        elif mode in _WIRE_Q:
+            vl = meta["view"]
+            xd = _unpack_view_padded(parts[c], meta["scales_dev"], mode,
+                                     off, vl["wrows"][i],
+                                     int(vl["soffs"][i]), vl["r0s"][i],
+                                     st.n, plan.nout)
+            _count_dispatch("unpack")
+            outs.append((_run_stage_gather(st, xd, plan),))
+            _count_dispatch("gather")
         else:
             # Gather-path programs are keyed by series length: restore
             # the plan-wide padded length so all stages share one
@@ -839,8 +911,10 @@ def _queue_stages(plan, batch, prepared=None, shipped=None):
             xd = jax.lax.slice_in_dim(parts[c], off, off + st.n, axis=-1)
             xd = jnp.pad(xd.astype(jnp.float32),
                          [(0, 0), (0, plan.nout - st.n)])
-            outs.append(_run_stage_gather(st, xd, plan))
-    return outs
+            _count_dispatch("unpack")
+            outs.append((_run_stage_gather(st, xd, plan),))
+            _count_dispatch("gather")
+    return outs, tuple(layout)
 
 
 def queue_search_batch(plan, batch, tobs, prepared=None, shipped=None,
@@ -854,8 +928,9 @@ def queue_search_batch(plan, batch, tobs, prepared=None, shipped=None,
     from .peaks_device import queue_find_peaks
 
     pp = _peak_plan(plan, tobs, **peak_kwargs)
-    outs = _queue_stages(plan, batch, prepared=prepared, shipped=shipped)
-    snr_dev = _assemble_device(plan, *outs)
+    outs, layout = _queue_stages(plan, batch, prepared=prepared,
+                                 shipped=shipped)
+    snr_dev = _assemble_device(plan, layout, *outs)
     return pp, queue_find_peaks(pp, snr_dev)
 
 
@@ -909,29 +984,52 @@ def run_periodogram(plan, data):
     data = np.asarray(data, dtype=np.float32)
     if data.size != plan.size:
         raise ValueError("data length does not match plan size")
-    outs = _queue_stages(plan, data[None])
+    outs, layout = _queue_stages(plan, data[None])
     # Device-side assembly, then ONE device->host pull: per-stage pulls
     # each pay the interconnect round trip (~0.1-0.4 s through a
     # tunneled device x 22 stages dominated single-series latency).
     snrs = np.ascontiguousarray(
-        np.asarray(_assemble_device(plan, *outs)[0]), dtype=np.float32
+        np.asarray(_assemble_device(plan, layout, *outs)[0]),
+        dtype=np.float32,
     )
     return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs
+
+
+def _part_rows(plan, mode):
+    """Per-stage row count of the wire part serving it (the fused
+    builds are keyed by the part shapes, so warmup must reproduce the
+    exact :func:`_wire_parts` split)."""
+    rows = {}
+    for start, end, stages in _wire_parts(plan, mode):
+        rows.update({i: end - start for i, _ in stages})
+    return rows
 
 
 def warm_stage_kernels(plan, D, parallel=True):
     """AOT-compile (or load from the cross-process executable cache)
     every distinct cycle-kernel bucket a D-trial search of this plan
-    will dispatch. With ``parallel``, buckets compile CONCURRENTLY —
-    Mosaic compiles run in a compiler service, so threads overlap them
-    (measured: two compiles take one compile's wall time). Returns the
-    number of distinct kernel builds warmed."""
+    will dispatch (fused single-dispatch builds per lane bucket on the
+    quantised-wire path, the two-dispatch form elsewhere). With
+    ``parallel``, buckets compile CONCURRENTLY — Mosaic compiles run in
+    a compiler service, so threads overlap them (measured: two compiles
+    take one compile's wall time). Returns the number of distinct
+    kernel builds warmed."""
     if _ffa_path() != "kernel":
         return 0
     interpret = jax.default_backend() == "cpu"
+    mode = _wire_mode("kernel")
     calls = {}
-    for st in plan.stages:
-        if _kernel_eligible(st, plan):
+    if mode in _WIRE_Q:
+        vl = _view_layout(plan, mode)
+        prows = _part_rows(plan, mode)
+        srows = vl["stot"]
+    for i, st in enumerate(plan.stages):
+        if mode in _WIRE_Q and _fused_eligible(st, plan, mode):
+            for _, kern in st.cycle_kernels(interpret=interpret):
+                c = kern.build_fused(D, mode, vl["PW"], prows[i], srows)
+                if hasattr(c, "warm"):
+                    calls.setdefault(id(c), c)
+        elif _kernel_eligible(st, plan):
             c = st.cycle_kernel(interpret=interpret).build(D)
             if hasattr(c, "warm"):
                 calls.setdefault(id(c), c)
@@ -944,10 +1042,17 @@ def warm_stage_kernels(plan, D, parallel=True):
         for c in calls.values():
             c.warm()
     for c in calls.values():
-        # key = (L, NL, rows, P, RS, widths, nspread, pbits, D, B, resident)
         k = c.key
-        log.info("bucket L=%d rows=%d P=%d B=%d D=%d: %s in %.1fs",
-                 k[0], k[2], k[3], k[9], k[8], c.source, c.warm_seconds)
+        if k[0] == "fused":
+            # ("fused", mode, L, NL, rows, P, RS, widths, nspread,
+            #  pbits, sbits, D, B, PW, wrows, srows, resident)
+            log.info("fused %s bucket L=%d rows=%d P=%d B=%d D=%d: %s "
+                     "in %.1fs", k[1], k[2], k[4], k[5], k[12], k[11],
+                     c.source, c.warm_seconds)
+        else:
+            # (L, NL, rows, P, RS, widths, nspread, pbits, D, B, resident)
+            log.info("bucket L=%d rows=%d P=%d B=%d D=%d: %s in %.1fs",
+                     k[0], k[2], k[3], k[9], k[8], c.source, c.warm_seconds)
     return len(calls)
 
 
@@ -976,9 +1081,9 @@ def run_periodogram_batch(plan, batch):
     # host/device overlap run prepare_stage_data / ship_stage_data for
     # the NEXT batch while this one computes (see pipeline.batcher and
     # bench.py).
-    outs = _queue_stages(plan, batch)
+    outs, layout = _queue_stages(plan, batch)
     # Device-side assembly + one pull (see run_periodogram).
     snrs = np.ascontiguousarray(
-        np.asarray(_assemble_device(plan, *outs)), dtype=np.float32
+        np.asarray(_assemble_device(plan, layout, *outs)), dtype=np.float32
     )
     return plan.all_periods.copy(), plan.all_foldbins.copy(), snrs

@@ -32,7 +32,6 @@ every selected point still reaches the host.
 """
 import logging
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 from functools import partial
@@ -202,7 +201,12 @@ class PeakPlan:
         vals = jnp.take_along_axis(
             sblk, jnp.clip(ids, 0, nb - 1)[..., None], axis=2
         )                                               # (D, NW, CAP, BLK)
-        f32 = partial(jax.lax.bitcast_convert_type, new_dtype=jnp.float32)
+        # Integer fields travel as float32 VALUES (exact: counts <= BLK
+        # and block ids < nb are far below 2^24), NOT bitcasts — a
+        # bitcast of a small int is a denormal, and the dm-sharded
+        # execution path flushes denormals to zero (observed: block ids
+        # 24/38 arriving as 0 while the NaN-payload -1 survived).
+        f32 = partial(jnp.asarray, dtype=jnp.float32)
         return jnp.concatenate(
             [stats.ravel(), f32(cnt).ravel(), f32(ids).ravel(), vals.ravel()]
         )
@@ -214,8 +218,8 @@ class PeakPlan:
                  D * NW * CAP * BLK]
         offs = np.concatenate([[0], np.cumsum(sizes)])
         stats = buf[offs[0]:offs[1]].reshape(D, NW, nseg, 3)
-        cnt = buf[offs[1]:offs[2]].view(np.int32).reshape(D, NW, nb)
-        ids = buf[offs[2]:offs[3]].view(np.int32).reshape(D, NW, CAP)
+        cnt = buf[offs[1]:offs[2]].astype(np.int32).reshape(D, NW, nb)
+        ids = buf[offs[2]:offs[3]].astype(np.int32).reshape(D, NW, CAP)
         vals = buf[offs[3]:offs[4]].reshape(D, NW, CAP, BLK)
         return stats, cnt, ids, vals
 

@@ -25,6 +25,7 @@ XLA retraces to O(log(m_max)) per search configuration.
 """
 from functools import lru_cache
 import math
+import os
 
 import numpy as np
 
@@ -151,8 +152,37 @@ class CycleStage:
 
         return max(num_levels(m) for m in self.ms_padded)
 
+    @property
+    def lane_buckets(self):
+        """Lane-occupancy partition of the stage's padded problem
+        indices: problems grouped by lane-tile count ceil(p / 128), so
+        each group's kernel container is only as wide as ITS largest
+        trial. At the headline config (bins 240-260, P = 384) the dense
+        grid wastes ~1/3 of every lane: splitting at the p = 256 tile
+        boundary runs 17 of 21 trials in a 256-lane container and only
+        the 4 widest at 384, cutting the kernel's padded lane work by
+        ~27%. Disabled (one bucket) with RIPTIDE_KERNEL_LANE_SPLIT=0.
+        Bucket membership depends only on the bins list, which is
+        identical for every stage of a plan, so bucket B counts — and
+        therefore compiled-kernel shapes — are shared across stages."""
+        split = os.environ.get("RIPTIDE_KERNEL_LANE_SPLIT", "1") != "0"
+        cached = getattr(self, "_lane_buckets", None)
+        if cached is not None and cached[0] == split:
+            return cached[1]
+        if split:
+            tiles = {}
+            for i, p in enumerate(self.ps_padded):
+                tiles.setdefault(-(-p // 128), []).append(i)
+            buckets = tuple(tuple(ix) for _, ix in sorted(tiles.items()))
+        else:
+            buckets = (tuple(range(len(self.ps_padded))),)
+        self._lane_buckets = (split, buckets)
+        return buckets
+
     def cycle_kernel(self, interpret=False):
-        """Lazily-built fused Pallas :class:`CycleKernel` for this stage."""
+        """Lazily-built fused Pallas :class:`CycleKernel` for this stage
+        (the full bins-trial batch in one bucket — the two-dispatch
+        fallback path and tooling use this form)."""
         k = getattr(self, "_cycle_kernel", None)
         if k is None or k.interpret != bool(interpret):
             from ..ops.ffa_kernel import CycleKernel
@@ -164,6 +194,29 @@ class CycleStage:
             )
             self._cycle_kernel = k
         return k
+
+    def cycle_kernels(self, interpret=False):
+        """Lazily-built per-lane-bucket kernels for the fused
+        single-dispatch path: list of (problem indices, CycleKernel).
+        Each bucket gets its own container depth (L from ITS largest m,
+        often shallower for the wide-p bucket) and lane width."""
+        key = (self.lane_buckets, bool(interpret))
+        cached = getattr(self, "_cycle_kernels", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from ..ops.ffa_kernel import CycleKernel
+
+        kernels = []
+        for idx in self.lane_buckets:
+            ix = list(idx)
+            kernels.append((idx, CycleKernel(
+                [self.ms_padded[i] for i in ix],
+                [self.ps_padded[i] for i in ix],
+                self.widths, self.hcoef[ix], self.bcoef[ix],
+                self.stdnoise[ix], interpret=interpret,
+            )))
+        self._cycle_kernels = (key, kernels)
+        return kernels
 
 
 class PeriodogramPlan:

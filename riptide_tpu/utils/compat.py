@@ -1,0 +1,37 @@
+"""
+Version shims for the narrow band of jax APIs whose names moved between
+the 0.4.x line and current releases. The framework targets current jax;
+these shims keep the SAME call sites working on a 0.4.x runtime (the CI
+image pins 0.4.37) instead of failing with AttributeError at program
+build time:
+
+* ``pallas_compiler_params`` — ``pltpu.CompilerParams`` was named
+  ``TPUCompilerParams`` on 0.4.x. Construction arguments used here
+  (``vmem_limit_bytes``) are identical.
+* ``shard_map`` — ``jax.shard_map`` graduated from
+  ``jax.experimental.shard_map.shard_map``; the replication-check
+  keyword was renamed ``check_rep`` -> ``check_vma`` in the move.
+
+Call sites pass the CURRENT names/keywords; the shim translates only
+when running on the old runtime.
+"""
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["pallas_compiler_params", "shard_map"]
+
+
+if hasattr(pltpu, "CompilerParams"):
+    pallas_compiler_params = pltpu.CompilerParams
+else:  # jax 0.4.x
+    pallas_compiler_params = pltpu.TPUCompilerParams
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)

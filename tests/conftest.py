@@ -49,6 +49,11 @@ def pytest_configure(config):
         "tpu: needs the real TPU backend (run via `make tests-tpu`; "
         "skipped in the default CPU suite)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: minutes-scale on the CPU backend (tier-1 deselects via "
+        "-m 'not slow'; still run by `make tests`)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

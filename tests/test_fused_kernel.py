@@ -1,0 +1,211 @@
+"""
+Fused single-dispatch wire->kernel path (search/engine.py:_run_stage_fused
++ ops/ffa_kernel.py:_fused_kernel): each kernel-eligible cascade stage
+runs wire decode + dequant + (m, p) pack + FFA + boxcar S/N as ONE
+Pallas program per lane bucket, fed straight from the shipped byte-plane
+wire view.
+
+Correctness chain covered here (all interpret mode, CPU):
+
+* fused program == two-dispatch XLA-pack + kernel path, BITWISE, for
+  every quantised wire mode (uint6/uint8/uint12) including odd-length
+  stage tails — the in-kernel decode/pack mirrors engine._udecode_view
+  operation for operation — and within the transport's S/N budget of
+  the float32-wire kernel path (the numpy-oracle anchor: the float32
+  kernel path is oracle-tested in test_ffa_kernel.py);
+* dispatch-count regression: one fused device program per eligible
+  stage lane bucket, ZERO separate pack programs (the former per-stage
+  XLA pack dispatch and its (D, B, rows, P) container HBM round-trip);
+* lane-split occupancy buckets (p <= 128-tile boundary) produce
+  bit-identical results to the unsplit container;
+* on-device peaks through the fused path == host find_peaks on the
+  pulled S/N cube, byte-identical down to the peaks.csv serialisation.
+
+Configs are deliberately tiny (two cascade stages, 4 bins-trials):
+interpret-mode Pallas emulates every DMA and roll, so each search costs
+seconds — the shapes still cover multi-stage wiring, shipped-part
+offsets, odd tails and both container families.
+"""
+import numpy as np
+import pytest
+
+import riptide_tpu.search.engine as eng
+from riptide_tpu.search.plan import periodogram_plan
+from riptide_tpu.survey.metrics import MetricsRegistry, set_metrics
+
+# Two-stage cascade, 4 bins-trials, odd stage lengths (2500/2353):
+# full coverage of the fused machinery at interpret-mode cost.
+SIZE, TSAMP, WIDTHS = 2500, 1e-3, (1, 2, 3)
+PMIN, PMAX, BMIN, BMAX = 64e-3, 0.072, 64, 67
+# segwidth sized for the short series: >= 3 threshold control points
+# (the Vandermonde normal matrix must stay invertible at tobs = 2.5 s).
+PKW = dict(smin=6.0, segwidth=0.5, nstd=6.0, minseg=10, polydeg=2, clrad=0.1)
+
+
+@pytest.fixture(scope="module")
+def plan():
+    return periodogram_plan(SIZE, TSAMP, WIDTHS, PMIN, PMAX, BMIN, BMAX)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    return rng.standard_normal(SIZE).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def snr_f32(plan, data):
+    """Exact-wire kernel-path reference (oracle-anchored via
+    test_ffa_kernel.py), shared by every mode's budget check."""
+    import os
+
+    old = {k: os.environ.get(k) for k in
+           ("RIPTIDE_FFA_PATH", "RIPTIDE_WIRE_DTYPE")}
+    os.environ["RIPTIDE_FFA_PATH"] = "kernel"
+    os.environ["RIPTIDE_WIRE_DTYPE"] = "float32"
+    try:
+        return eng.run_periodogram(plan, data)[2]
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+@pytest.fixture()
+def kernel_path(monkeypatch):
+    monkeypatch.setenv("RIPTIDE_FFA_PATH", "kernel")
+    return monkeypatch
+
+
+def test_plan_has_fused_stages_and_odd_tails(plan):
+    assert len(plan.stages) >= 2
+    assert all(eng._fused_eligible(st, plan, "uint6") for st in plan.stages)
+    PW = eng._view_width(plan)
+    assert any(st.n % PW for st in plan.stages), "want odd-length tails"
+
+
+@pytest.mark.parametrize("mode,tol", [("uint6", 0.3), ("uint8", 0.1),
+                                      ("uint12", 0.01)])
+def test_fused_bitwise_equals_two_dispatch(plan, data, snr_f32, kernel_path,
+                                           mode, tol):
+    """The fused program's decode+pack mirrors the XLA pack path op for
+    op, so the S/N cube must match BITWISE — any drift means the two
+    decoders diverged. The same cube must sit within the transport's
+    S/N error budget of the exact float32 wire."""
+    kernel_path.setenv("RIPTIDE_WIRE_DTYPE", mode)
+    _, _, s_fused = eng.run_periodogram(plan, data)
+    kernel_path.setattr(eng, "_fused_eligible", lambda *a: False)
+    _, _, s_two = eng.run_periodogram(plan, data)
+    np.testing.assert_array_equal(s_fused, s_two)
+    assert np.max(np.abs(s_fused - snr_f32)) < tol
+
+
+def test_fused_dispatch_counts(plan, data, kernel_path):
+    """THE single-dispatch regression test: per eligible stage exactly
+    one fused device program per lane bucket, and NO separate pack
+    program. The pack entry points are also tripwired so a silent
+    routing regression cannot pass."""
+    kernel_path.setenv("RIPTIDE_WIRE_DTYPE", "uint6")
+
+    def _no_pack(*a, **k):
+        raise AssertionError("separate pack program dispatched on the "
+                             "fused path")
+
+    kernel_path.setattr(eng, "_pack_static_view", _no_pack)
+    kernel_path.setattr(eng, "_pack_static", _no_pack)
+    reg = MetricsRegistry()
+    prev = set_metrics(reg)
+    try:
+        eng.run_periodogram(plan, data)
+    finally:
+        set_metrics(prev)
+    s = reg.summary()
+    want_fused = sum(len(st.lane_buckets) for st in plan.stages
+                     if eng._fused_eligible(st, plan, "uint6"))
+    assert want_fused == len(plan.stages)  # all stages eligible here
+    assert s.get("dispatch_fused") == want_fused
+    assert s.get("dispatch_pack", 0) == 0
+    assert s.get("dispatch_kernel", 0) == 0
+    assert s.get("dispatch_gather", 0) == 0
+
+
+def test_fused_dm_batch_and_peaks_byte_identical(plan, kernel_path):
+    """(D, N) batches through the fused path with ON-DEVICE peak
+    detection: each trial's S/N equals its own single-trial search
+    bitwise (the wire quantises per trial), device peaks == host
+    find_peaks on the pulled cube, and their CSV serialisations are
+    byte-identical (the bench parity gate's invariant, pinned on CPU)."""
+    import io
+
+    import pandas
+
+    from riptide_tpu.libffa import generate_signal
+    from riptide_tpu.metadata import Metadata
+    from riptide_tpu.peak_detection import find_peaks
+    from riptide_tpu.periodogram import Periodogram
+    from riptide_tpu.search.engine import (
+        collect_search_batch, queue_search_batch, search_snr_dev,
+    )
+
+    kernel_path.setenv("RIPTIDE_WIRE_DTYPE", "uint6")
+    rng = np.random.default_rng(14)
+    batch = rng.standard_normal((2, SIZE)).astype(np.float32)
+    np.random.seed(7)
+    batch[0] = generate_signal(SIZE, 0.068 / TSAMP, amplitude=16.0,
+                               ducy=0.05)
+    batch -= batch.mean(axis=1, keepdims=True)
+    batch /= batch.std(axis=1, keepdims=True)
+    tobs = SIZE * TSAMP
+
+    handle = queue_search_batch(plan, batch, tobs=tobs, **PKW)
+    snr = np.asarray(search_snr_dev(handle))
+    _, _, s1 = eng.run_periodogram(plan, batch[0])
+    np.testing.assert_array_equal(snr[0], s1)
+
+    md = Metadata({"dm": 0.0, "tobs": tobs})
+    pgram = Periodogram(plan.widths, plan.all_periods, plan.all_foldbins,
+                        snr[0], md)
+    host_peaks, _ = find_peaks(pgram, **PKW)
+    dev_peaks_all, _ = collect_search_batch(handle, np.zeros(2))
+    dev_peaks = dev_peaks_all[0]
+    assert dev_peaks, "expected the injected pulsar to be detected"
+    assert [tuple(p) for p in dev_peaks] == [tuple(p) for p in host_peaks]
+
+    def csv_bytes(peaks):
+        buf = io.StringIO()
+        pandas.DataFrame(peaks).to_csv(buf, index=False)
+        return buf.getvalue().encode()
+
+    assert csv_bytes(dev_peaks) == csv_bytes(host_peaks)
+
+
+def test_lane_split_bitwise_parity(kernel_path):
+    """A bins range crossing the 128-lane tile boundary splits into two
+    occupancy buckets; the split run must equal the unsplit container
+    BITWISE (pure re-bucketing, no numeric change)."""
+    lplan = periodogram_plan(4096, 1e-3, (1, 2), 0.126, 0.13, 126, 130)
+    assert len(lplan.stages) == 1  # one stage keeps interpret cost low
+    st0 = lplan.stages[0]
+    tiles = sorted({-(-p // 128) for p in st0.ps_padded})
+    assert tiles == [1, 2]
+    assert len(st0.lane_buckets) == 2
+    kernel_path.setenv("RIPTIDE_WIRE_DTYPE", "uint6")
+    rng = np.random.default_rng(13)
+    d = rng.standard_normal(4096).astype(np.float32)
+    _, _, s_split = eng.run_periodogram(lplan, d)
+
+    kernel_path.setenv("RIPTIDE_KERNEL_LANE_SPLIT", "0")
+    assert len(st0.lane_buckets) == 1
+    _, _, s_one = eng.run_periodogram(lplan, d)
+    np.testing.assert_array_equal(s_split, s_one)
+
+
+def test_gather_path_decodes_view_wire(plan, data, monkeypatch):
+    """The gather path (CPU default) must decode the SAME byte-plane
+    wire: quantised gather search within budget of its float32 gather
+    result."""
+    monkeypatch.setenv("RIPTIDE_FFA_PATH", "gather")
+    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "float32")
+    _, _, s32 = eng.run_periodogram(plan, data)
+    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "uint6")
+    _, _, s6 = eng.run_periodogram(plan, data)
+    assert np.max(np.abs(s6 - s32)) < 0.3

@@ -1,62 +1,91 @@
 """
-Wire-transport tests: the 12-bit packed host->device format
-(search/engine.py:_prepare_u12 / _u12_decode, native
-rn_prepare_wire_u12) and its layout bookkeeping.
+Wire-transport tests: the quantised byte-plane VIEW formats
+(search/engine.py:_prepare_uint / _udecode_view, native
+rn_prepare_wire_view) and their layout bookkeeping.
+
+Each stage ships as a (R0, PW) sample view with one float32 scale per
+view row and `group` consecutive rows packed across byte planes — the
+layout the fused Pallas kernel decodes with dense elementwise ops (no
+byte-strided lane relayout).
 """
 import numpy as np
 import pytest
 
 from riptide_tpu import native
+from riptide_tpu.ops.ffa_kernel import WIRE_MODES
 from riptide_tpu.search import periodogram_plan
 from riptide_tpu.search.engine import (
-    _prepare_u12,
-    _prepare_u8,
-    _scale_layout,
-    _u12_decode,
-    _u8_decode,
+    _decode_stage_rows,
+    _prepare_uint,
+    _view_layout,
+    _view_width,
     _wire_layout,
     prepare_stage_data,
     run_periodogram,
 )
+
+QMAX = {"uint6": 31.0, "uint8": 127.0, "uint12": 2047.0}
 
 
 def _plan():
     return periodogram_plan(4096, 1e-3, (1, 2, 3), 64e-3, 0.15, 64, 71)
 
 
-def test_u12_roundtrip_error_bound():
-    """decode(encode(x)) must be within half a quantisation step of x
-    for every sample of every stage."""
+def _decode_all(plan, mode, flat, scales):
+    """Decode every stage of a prepared wire back to (D, n) samples."""
+    import jax.numpy as jnp
+
+    vl = _view_layout(plan, mode)
+    outs = []
+    for i, st in enumerate(plan.stages):
+        dec = _decode_stage_rows(
+            mode, jnp.asarray(flat), jnp.asarray(scales)[..., None],
+            int(vl["roffs"][i]), int(vl["wrows"][i]),
+            int(vl["soffs"][i]), int(vl["r0s"][i]), st.n,
+        )
+        outs.append(np.asarray(dec))
+    return outs
+
+
+@pytest.mark.parametrize("mode", ["uint6", "uint8", "uint12"])
+def test_view_roundtrip_error_bound(mode):
+    """decode(encode(x)) within half a quantisation step of x for every
+    sample of every stage, with the step set by that sample's per-row
+    scale."""
     plan = _plan()
     rng = np.random.default_rng(0)
     batch = rng.standard_normal((3, plan.size)).astype(np.float32)
-    flat, scales = _prepare_u12(plan, batch)
-    offs, lens, tot = _wire_layout(plan, "uint12")
-    assert flat.shape == (3, tot)
+    flat, scales = _prepare_uint(plan, batch, mode)
+    vl = _view_layout(plan, mode)
+    assert flat.shape == (3, vl["tot_rows"], vl["PW"])
+    assert scales.shape == (3, vl["stot"])
     from riptide_tpu.search.engine import _host_downsample_all
 
     xds = _host_downsample_all(plan, batch, np.float32)
+    decs = _decode_all(plan, mode, flat, scales)
+    PW = vl["PW"]
     for i, st in enumerate(plan.stages):
-        seg = flat[:, offs[i] : offs[i] + lens[i]]
-        dec = np.asarray(_u12_decode(seg, scales[i]))[:, : st.n]
         want = xds[i][..., : st.n]
-        step = scales[i][:, None]
-        assert np.all(np.abs(dec - want) <= 0.5 * step + 1e-6), i
+        # per-sample step: the scale of the sample's view row
+        rows = np.arange(st.n) // PW
+        step = scales[:, vl["soffs"][i] + rows]
+        assert np.all(np.abs(decs[i] - want) <= 0.5 * step + 1e-6), (mode, i)
 
 
-def test_u12_native_matches_numpy_fallback(monkeypatch):
+@pytest.mark.parametrize("mode", ["uint6", "uint8", "uint12"])
+def test_native_matches_numpy_fallback(mode, monkeypatch):
     """The native single-pass wire preparation must produce the exact
     bytes and scales of the numpy fallback (same float64 accumulation,
-    same round-half-even quantisation)."""
+    same float32 reciprocal, same round-half-even)."""
     if not native.available():
         pytest.skip("native library unavailable")
     plan = _plan()
     rng = np.random.default_rng(1)
     batch = rng.standard_normal((2, plan.size)).astype(np.float32)
-    got_flat, got_scales = _prepare_u12(plan, batch)
+    got_flat, got_scales = _prepare_uint(plan, batch, mode)
 
     monkeypatch.setattr(native, "available", lambda: False)
-    want_flat, want_scales = _prepare_u12(plan, batch)
+    want_flat, want_scales = _prepare_uint(plan, batch, mode)
     np.testing.assert_array_equal(got_scales, want_scales)
     np.testing.assert_array_equal(got_flat, want_flat)
 
@@ -81,9 +110,10 @@ def test_prepare_stage_data_meta(monkeypatch):
     batch = np.zeros((2, plan.size), np.float32)
     flat, meta = prepare_stage_data(plan, batch)
     assert meta["mode"] == "uint12"
-    assert flat.dtype == np.uint8
-    assert meta["scales"].shape == (len(plan.stages), 2)
-    # all-zero input: scale falls back to 1.0, bytes encode q = 2048
+    assert flat.dtype == np.uint8 and flat.ndim == 3
+    vl = meta["view"]
+    assert flat.shape == (2, vl["tot_rows"], vl["PW"])
+    # all-zero input: scale falls back to 1.0, samples encode q = bias
     assert np.all(meta["scales"] == 1.0)
 
     monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "bogus")
@@ -91,99 +121,37 @@ def test_prepare_stage_data_meta(monkeypatch):
         prepare_stage_data(plan, batch)
 
 
-def test_u8_roundtrip_error_bound():
-    """decode(encode(x)) within half a block-quantisation step."""
-    plan = _plan()
-    rng = np.random.default_rng(3)
-    batch = rng.standard_normal((3, plan.size)).astype(np.float32)
-    flat, scales = _prepare_u8(plan, batch)
-    offs, lens, tot = _wire_layout(plan, "uint8")
-    soffs, nblks, stot = _scale_layout(plan)
-    assert flat.shape == (3, tot) and scales.shape == (3, stot)
-    from riptide_tpu.search.engine import _host_downsample_all
+@pytest.mark.parametrize("mode", ["uint6", "uint8", "uint12"])
+def test_view_layout_bookkeeping(mode):
+    """Stage extents tile the wire without overlap, scales cover every
+    view row, and the tail slack is present for the fused kernel's
+    chunked DMA over-reads."""
+    from riptide_tpu.ops.ffa_kernel import DMA_CHUNK
 
-    xds = _host_downsample_all(plan, batch, np.float32)
+    plan = _plan()
+    vl = _view_layout(plan, mode)
+    group, planes = WIRE_MODES[mode]
+    PW = _view_width(plan)
+    assert vl["PW"] == PW and PW % 128 == 0
+    pos = 0
     for i, st in enumerate(plan.stages):
-        seg = flat[:, offs[i] : offs[i] + lens[i]]
-        sc = scales[:, soffs[i] : soffs[i] + nblks[i]]
-        dec = np.asarray(_u8_decode(seg, sc))[:, : st.n]
-        want = xds[i][..., : st.n]
-        step = np.repeat(sc, 256, axis=1)[:, : st.n]
-        assert np.all(np.abs(dec - want) <= 0.5 * step + 1e-6), i
+        r0 = -(-st.n // PW)
+        assert vl["r0s"][i] == r0
+        assert vl["prs"][i] == -(-r0 // group)
+        assert vl["wrows"][i] == planes * vl["prs"][i]
+        assert vl["roffs"][i] == pos
+        pos += vl["wrows"][i]
+    assert vl["tot_rows"] >= pos + DMA_CHUNK
+    assert vl["stot"] >= sum(vl["r0s"])
+    offs, lens, tot = _wire_layout(plan, mode)
+    assert list(offs) == list(vl["roffs"]) and tot == vl["tot_rows"]
 
 
-def test_u8_native_matches_numpy_fallback(monkeypatch):
-    if not native.available():
-        pytest.skip("native library unavailable")
+def test_float_modes_keep_flat_layout():
     plan = _plan()
-    rng = np.random.default_rng(4)
-    batch = rng.standard_normal((2, plan.size)).astype(np.float32)
-    got_flat, got_scales = _prepare_u8(plan, batch)
-    monkeypatch.setattr(native, "available", lambda: False)
-    want_flat, want_scales = _prepare_u8(plan, batch)
-    np.testing.assert_array_equal(got_scales, want_scales)
-    np.testing.assert_array_equal(got_flat, want_flat)
-
-
-def test_u8_search_close_to_exact(monkeypatch):
-    """Full periodogram through the uint8 block-adaptive wire stays
-    within S/N 0.1 of the float32-wire result at every trial."""
-    plan = _plan()
-    rng = np.random.default_rng(5)
-    data = rng.standard_normal(plan.size).astype(np.float32)
-    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "float32")
-    _, _, snr32 = run_periodogram(plan, data)
-    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "uint8")
-    _, _, snr8 = run_periodogram(plan, data)
-    assert np.max(np.abs(snr32 - snr8)) < 0.1
-
-
-def test_u6_roundtrip_error_bound():
-    """decode(encode(x)) within half a 6-bit block-quantisation step."""
-    from riptide_tpu.search.engine import _prepare_u6, _u6_decode
-
-    plan = _plan()
-    rng = np.random.default_rng(6)
-    batch = rng.standard_normal((3, plan.size)).astype(np.float32)
-    flat, scales = _prepare_u6(plan, batch)
-    offs, lens, tot = _wire_layout(plan, "uint6")
-    soffs, nblks, stot = _scale_layout(plan)
-    assert flat.shape == (3, tot) and scales.shape == (3, stot)
-    from riptide_tpu.search.engine import _host_downsample_all
-
-    xds = _host_downsample_all(plan, batch, np.float32)
-    for i, st in enumerate(plan.stages):
-        seg = flat[:, offs[i] : offs[i] + lens[i]]
-        sc = scales[:, soffs[i] : soffs[i] + nblks[i]]
-        dec = np.asarray(_u6_decode(seg, sc))[:, : st.n]
-        want = xds[i][..., : st.n]
-        step = np.repeat(sc, 256, axis=1)[:, : st.n]
-        assert np.all(np.abs(dec - want) <= 0.5 * step + 1e-6), i
-
-
-def test_u6_native_matches_numpy_fallback(monkeypatch):
-    from riptide_tpu.search.engine import _prepare_u6
-
-    if not native.available():
-        pytest.skip("native library unavailable")
-    plan = _plan()
-    rng = np.random.default_rng(7)
-    batch = rng.standard_normal((2, plan.size)).astype(np.float32)
-    got_flat, got_scales = _prepare_u6(plan, batch)
-    monkeypatch.setattr(native, "available", lambda: False)
-    want_flat, want_scales = _prepare_u6(plan, batch)
-    np.testing.assert_array_equal(got_scales, want_scales)
-    np.testing.assert_array_equal(got_flat, want_flat)
-
-
-def test_u6_search_close_to_exact(monkeypatch):
-    """Full periodogram through the uint6 wire stays within S/N 0.25 of
-    the float32-wire result at every trial (4x uint8's step)."""
-    plan = _plan()
-    rng = np.random.default_rng(8)
-    data = rng.standard_normal(plan.size).astype(np.float32)
-    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "float32")
-    _, _, snr32 = run_periodogram(plan, data)
-    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "uint6")
-    _, _, snr6 = run_periodogram(plan, data)
-    assert np.max(np.abs(snr32 - snr6)) < 0.25
+    offs, lens, tot = _wire_layout(plan, "float32")
+    assert tot == sum(st.n for st in plan.stages)
+    batch = np.zeros((1, plan.size), np.float32)
+    flat, meta = prepare_stage_data(plan, batch, mode="float32")
+    assert flat.shape == (1, tot) and flat.dtype == np.float32
+    assert meta["scales"] is None

@@ -88,13 +88,14 @@ def main():
                     sync(dev)
                 elif cmd == "stages":
                     t0 = time.perf_counter()
-                    outs = _queue_stages(plan, batch, state.get("prep"))
-                    sync(outs[-1])
-                    state["outs"] = outs
+                    outs, layout = _queue_stages(plan, batch,
+                                                 state.get("prep"))
+                    sync(outs[-1][0])
+                    state["outs"], state["layout"] = outs, layout
                 elif cmd == "assemble":
                     outs = state["outs"]
                     t0 = time.perf_counter()
-                    snr = _assemble_device(plan, *outs)
+                    snr = _assemble_device(plan, state["layout"], *outs)
                     sync(snr)
                     state["snr"] = snr
                 elif cmd == "stats":

@@ -21,6 +21,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from riptide_tpu.utils.compat import pallas_compiler_params
+
 R, P = 1536, 384
 L, NL = 11, 3
 
@@ -85,7 +87,7 @@ def main():
                       pl.BlockSpec(memory_space=pltpu.VMEM)],
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((R, P), jnp.float32),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pallas_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024),
         )
         t0 = time.perf_counter()

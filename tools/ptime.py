@@ -18,6 +18,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from riptide_tpu.utils.compat import pallas_compiler_params
+
 ROWS, P = 2048, 384
 REPS = 32
 
@@ -86,7 +88,7 @@ def build(kern, with_scal=False, shape=(ROWS, P)):
         in_specs=in_specs,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(shape, jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             vmem_limit_bytes=100 * 1024 * 1024
         ),
     ))

@@ -16,6 +16,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from riptide_tpu.utils.compat import pallas_compiler_params
+
 
 def build(body_fn, shape, niter):
     def kern(x_ref, o_ref):
@@ -28,7 +30,7 @@ def build(body_fn, shape, niter):
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(shape, jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             vmem_limit_bytes=100 * 1024 * 1024),
     ))
 

@@ -14,8 +14,15 @@ Also runs two microbenches first:
   wire    raw device_put of a wire-sized buffer, 3x (today's tunnel rate)
   rtt     tiny device_put + pull roundtrip, 5x (today's tunnel latency)
 
+The LAST stdout line is a machine-readable JSON block with the same
+dtime-style decomposition bench.py emits (device_s / prep_s /
+wire_MBps / chunk_s plus trials_per_sec and the dispatch_* counters),
+so driver logs capture where a round's time went even when only the
+tail survives.
+
 Usage: python tools/stime.py [D] [CHUNKS]
 """
+import json
 import os
 import sys
 import time
@@ -85,6 +92,13 @@ def main(D=32, CHUNKS=4):
     collect_search_batch(h, dms)
     print(f"warmup pass: {time.perf_counter()-t0:.1f}s", flush=True)
 
+    # Metrics window covering exactly the timed loop below, so the
+    # closing JSON block decomposes the steady-state chunks only.
+    from riptide_tpu.survey.metrics import get_metrics
+
+    metrics = get_metrics()
+    metrics.reset()
+
     with ThreadPoolExecutor(max_workers=1) as ex:
         def prep(i):
             t0 = time.perf_counter()
@@ -133,6 +147,18 @@ def main(D=32, CHUNKS=4):
         dt = time.perf_counter() - tstart
         print(f"steady: {CHUNKS} chunks in {dt:.2f}s = "
               f"{D*CHUNKS/dt:.2f} trials/s", flush=True)
+        s = metrics.summary()
+        block = {
+            "metric": "stime_decomposition",
+            "trials_per_sec": round(D * CHUNKS / dt, 3),
+            "device_s": round(s.get("device_s", 0.0), 3),
+            "prep_s": round(s.get("prep_s", 0.0), 3),
+            "wire_MBps": s.get("wire_MBps"),
+            "chunk_s": round(dt / max(CHUNKS, 1), 3),
+        }
+        block.update({k: v for k, v in s.items()
+                      if k.startswith("dispatch_")})
+        print(json.dumps(block), flush=True)
 
 
 if __name__ == "__main__":
