@@ -2,17 +2,45 @@
 
 PYTHON ?= python
 
-.PHONY: install check tests tests-cov native bench clean
+.PHONY: install check lint native-asan sanitize tests tests-cov native \
+	bench clean
 
 install:
 	$(PYTHON) -m pip install -e .
 
-# Static AST lints (also enforced in tier-1 via tests/): the finite-guard
-# discipline on data entry points and the bounded-wait discipline on
-# multi-host collectives.
+# Static analysis: the riplint framework (tools/riplint.py — 7 analyzers
+# including the ported finite/liveness guards) against the checked-in
+# baseline. Also enforced in tier-1 via tests/test_riplint.py; the old
+# tools/check_*.py entry points remain as shims onto the same analyzers.
 check:
-	$(PYTHON) tools/check_finite_guards.py
-	$(PYTHON) tools/check_liveness_guards.py
+	$(PYTHON) tools/riplint.py
+
+# Everything static + the sanitizer-built native tests: the full
+# pre-merge hygiene gate.
+lint: check sanitize
+
+# ASan+UBSan flavor of the native host library. The sanitizer flags are
+# part of the build cache key (own .so next to the production one), and
+# the sanitized library only loads with the sanitizer runtimes
+# preloaded — hence the LD_PRELOAD. detect_leaks=0: CPython itself
+# "leaks" by ASan's definition; the target audits the C++ wire
+# producers, not the interpreter.
+ASAN_PRELOAD = $(shell g++ -print-file-name=libasan.so) \
+	$(shell g++ -print-file-name=libubsan.so)
+SAN_ENV = RIPTIDE_NATIVE_SANITIZE=1 LD_PRELOAD="$(ASAN_PRELOAD)" \
+	ASAN_OPTIONS=detect_leaks=0 UBSAN_OPTIONS=print_stacktrace=1
+
+native-asan:
+	$(SAN_ENV) $(PYTHON) -c "from riptide_tpu import native; \
+	assert native.available(), 'sanitized native build failed to load'"
+
+# Native-parity + wire byte-parity tests under the sanitized build.
+# -fno-sanitize-recover=all means any ASan/UBSan report aborts the
+# test process: green == zero sanitizer reports.
+sanitize: native-asan
+	$(SAN_ENV) PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+		tests/test_native.py \
+		"tests/test_wire.py::test_native_matches_numpy_fallback" -q
 
 # Run the test suite on the CPU backend (8 virtual devices). PYTHONPATH is
 # cleared so the axon TPU site customization does not claim the device for

@@ -1,0 +1,63 @@
+"""
+riplint: the shared static-analysis framework.
+
+A single AST walk over the package feeds seven analyzers, each owning
+one stable rule id (asserted by tests/test_riplint.py):
+
+========  ==========================  =====================================
+RIP001    host-sync                   no host synchronisation (`.item()`,
+                                      `block_until_ready`, numpy pulls)
+                                      inside jit-traced bodies or the
+                                      engine/batcher queueing hot paths
+RIP002    dtype-discipline            float64 accumulator rule + explicit
+                                      dtypes in ops/ and the kernel paths
+RIP003    env-flags                   every RIPTIDE_* read routes through
+                                      the typed utils/envflags.py registry
+                                      (stale entries + docs drift checked)
+RIP004    lock-discipline             no blocking call while holding a
+                                      lock, no untimed join()/wait(),
+                                      explicit Thread daemon flags
+RIP005    pallas-layout               static BlockSpec/grid shapes,
+                                      explicit memory spaces, no host
+                                      nondeterminism in kernel closures
+RIP006    finite-guards               data entry points route through the
+                                      quality layer (ported from
+                                      tools/check_finite_guards.py)
+RIP007    liveness-guards             multihost_utils collectives route
+                                      through the bounded-wait wrappers
+                                      (ported from
+                                      tools/check_liveness_guards.py)
+========  ==========================  =====================================
+
+Run via ``tools/riplint.py`` (GitHub-annotation output, checked-in
+baseline with per-entry justifications, ``# riplint: disable=RIPxxx``
+inline suppressions). This package must stay importable WITHOUT jax —
+the runner loads it standalone by file path so ``make check`` needs no
+backend.
+"""
+from .core import (  # noqa: F401
+    Analyzer, Baseline, Finding, ModuleContext, collect_contexts,
+    run_analyzers,
+)
+from .host_sync import HostSyncAnalyzer
+from .dtype_discipline import DtypeDisciplineAnalyzer
+from .env_flags import EnvFlagAnalyzer
+from .lock_discipline import LockDisciplineAnalyzer
+from .pallas_layout import PallasLayoutAnalyzer
+from .finite_guards import FiniteGuardAnalyzer
+from .liveness_guards import LivenessGuardAnalyzer
+
+ALL_ANALYZERS = (
+    HostSyncAnalyzer,
+    DtypeDisciplineAnalyzer,
+    EnvFlagAnalyzer,
+    LockDisciplineAnalyzer,
+    PallasLayoutAnalyzer,
+    FiniteGuardAnalyzer,
+    LivenessGuardAnalyzer,
+)
+
+__all__ = [
+    "ALL_ANALYZERS", "Analyzer", "Baseline", "Finding", "ModuleContext",
+    "collect_contexts", "run_analyzers",
+] + [a.__name__ for a in ALL_ANALYZERS]

@@ -1,0 +1,267 @@
+"""
+riplint framework core: module contexts, findings, suppressions,
+baseline handling and the runner loop shared by every analyzer.
+
+Design constraints:
+
+* importable WITHOUT jax or the riptide_tpu package __init__ (the
+  runner loads the analysis package standalone by file path, so
+  ``make check`` works on a box with no backend);
+* one ``ast.parse`` per module, shared by all analyzers;
+* suppression is explicit and reviewable — either an inline
+  ``# riplint: disable=RIPxxx`` pragma on the flagged line, or a
+  baseline entry in ``tools/riplint_baseline.json`` carrying a
+  one-line justification. Baseline entries match on (rule, path,
+  stripped source-line text), so they survive unrelated line moves but
+  die with the code they describe — a stale entry fails the run.
+"""
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Analyzer", "Baseline", "Finding", "ModuleContext",
+    "collect_contexts", "run_analyzers",
+]
+
+
+@dataclass
+class Finding:
+    """One rule violation at a source location (1-based line, 0-based
+    column, GitHub-annotation rendering)."""
+
+    path: str      # repo-relative, forward slashes
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def gh(self):
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    @classmethod
+    def at(cls, ctx, node, rule, message):
+        return cls(ctx.relpath, getattr(node, "lineno", 1),
+                   getattr(node, "col_offset", 0), rule, message)
+
+
+class ModuleContext:
+    """One parsed module: path, source, lines and AST, shared by every
+    analyzer (parse once)."""
+
+    def __init__(self, repo, relpath):
+        self.repo = repo
+        self.relpath = relpath.replace(os.sep, "/")
+        self.path = os.path.join(repo, relpath)
+        with open(self.path) as fobj:
+            self.source = fobj.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=self.path)
+
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class Analyzer:
+    """Base analyzer: subclass, set ``rule``/``name``/``description``,
+    implement :meth:`run` (per module) and optionally :meth:`finalize`
+    (whole-package checks, after every module ran)."""
+
+    rule = None
+    name = None
+    description = ""
+
+    def begin(self, repo):
+        """Reset per-run state. Called by :func:`run_analyzers` before
+        the module sweep so a reused *instance* (tests pass instances
+        to inject config) cannot leak accumulated state — e.g. a
+        wrapped-call counter — from a previous run into a later one."""
+
+    def run(self, ctx):
+        """Findings for one :class:`ModuleContext`."""
+        return []
+
+    def finalize(self, repo, contexts):
+        """Findings that need the whole package (vacuous-lint guards,
+        registry staleness, docs drift)."""
+        return []
+
+
+_PRAGMA = re.compile(r"#\s*riplint:\s*disable=([A-Za-z0-9_,\s]*)")
+
+
+def is_suppressed(finding, ctx):
+    """True when the flagged line carries an inline
+    ``# riplint: disable=RIPxxx[,RIPyyy]`` (or ``disable=all``)
+    pragma."""
+    m = _PRAGMA.search(ctx.line_text(finding.line))
+    if not m:
+        return False
+    rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return "all" in rules or finding.rule in rules
+
+
+class Baseline:
+    """Checked-in allowlist of intentional findings.
+
+    JSON schema: ``{"entries": [{"rule", "path", "line_text", "why"},
+    ...]}``. A finding is baselined when an entry's (rule, path,
+    stripped line_text) matches it; entries that match nothing are
+    STALE and fail the run (the code they justified is gone — delete
+    or update them)."""
+
+    def __init__(self, entries=(), path=None):
+        self.entries = [dict(e) for e in entries]
+        self.path = path
+        self._used = [False] * len(self.entries)
+
+    @classmethod
+    def load(cls, path):
+        if not os.path.exists(path):
+            return cls(path=path)
+        with open(path) as fobj:
+            data = json.load(fobj)
+        entries = data.get("entries", [])
+        for e in entries:
+            for k in ("rule", "path", "line_text", "why"):
+                if k not in e:
+                    raise ValueError(
+                        f"{path}: baseline entry missing {k!r}: {e}"
+                    )
+        return cls(entries, path=path)
+
+    def matches(self, finding, ctx):
+        text = ctx.line_text(finding.line).strip()
+        hit = False
+        for i, e in enumerate(self.entries):
+            if (e["rule"] == finding.rule and e["path"] == finding.path
+                    and e["line_text"].strip() == text):
+                self._used[i] = True
+                hit = True
+        return hit
+
+    def matches_pathonly(self, finding):
+        """Match for findings outside the package (no ModuleContext,
+        e.g. docs drift): an entry with an empty line_text on the same
+        (rule, path). Marks the entry used so it does not read as
+        stale."""
+        hit = False
+        for i, e in enumerate(self.entries):
+            if (e["rule"] == finding.rule and e["path"] == finding.path
+                    and e["line_text"].strip() == ""):
+                self._used[i] = True
+                hit = True
+        return hit
+
+    def stale_entries(self):
+        return [e for i, e in enumerate(self.entries) if not self._used[i]]
+
+    @staticmethod
+    def entry_for(finding, ctx, why="TODO: justify"):
+        return {
+            "rule": finding.rule,
+            "path": finding.path,
+            "line_text": ctx.line_text(finding.line).strip(),
+            "why": why,
+        }
+
+    def dump(self, path=None):
+        path = path or self.path
+        with open(path, "w") as fobj:
+            json.dump({"entries": self.entries}, fobj, indent=2,
+                      sort_keys=False)
+            fobj.write("\n")
+
+
+def collect_contexts(repo, package="riptide_tpu"):
+    """Parsed :class:`ModuleContext` for every ``.py`` module under
+    ``repo/package``, in stable path order."""
+    contexts = []
+    root = os.path.join(repo, package)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                rel = os.path.relpath(os.path.join(dirpath, fname), repo)
+                contexts.append(ModuleContext(repo, rel))
+    return contexts
+
+
+def run_analyzers(repo, analyzers, baseline=None, contexts=None):
+    """Run every analyzer over the package.
+
+    Returns ``(new, baselined, stale)``: findings not covered by pragma
+    or baseline, findings absorbed by the baseline, and stale baseline
+    entries. ``analyzers`` holds classes or instances."""
+    if contexts is None:
+        contexts = collect_contexts(repo)
+    baseline = baseline or Baseline()
+    instances = [a() if isinstance(a, type) else a for a in analyzers]
+    by_rel = {c.relpath: c for c in contexts}
+
+    new, baselined = [], []
+    for inst in instances:
+        inst.begin(repo)
+        found = []
+        for ctx in contexts:
+            found.extend(inst.run(ctx))
+        found.extend(inst.finalize(repo, contexts))
+        for f in found:
+            ctx = by_rel.get(f.path)
+            if ctx is not None and is_suppressed(f, ctx):
+                continue
+            if ctx is not None and baseline.matches(f, ctx):
+                baselined.append(f)
+                continue
+            # Findings outside the package (e.g. docs drift) can only
+            # be baselined with an empty line_text match.
+            if ctx is None and baseline.matches_pathonly(f):
+                baselined.append(f)
+                continue
+            new.append(f)
+    new.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return new, baselined, baseline.stale_entries()
+
+
+# -- small shared AST helpers -----------------------------------------------
+
+def dotted(node):
+    """Dotted-name string of a Name/Attribute chain (``jax.jit`` ->
+    "jax.jit"), or None for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node):
+    """Dotted name of a call's callee, or None."""
+    if isinstance(node, ast.Call):
+        return dotted(node.func)
+    return None
+
+
+def walk_functions(tree):
+    """Yield every (async) function/method node with its qualified
+    name ("Class.method" for methods)."""
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield qual, child
+                yield from visit(child, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, f"{prefix}{child.name}.")
+            else:
+                yield from visit(child, prefix)
+
+    yield from visit(tree, "")

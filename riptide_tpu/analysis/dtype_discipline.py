@@ -1,0 +1,148 @@
+"""
+RIP002 — dtype discipline in the numeric core.
+
+The reproduction's numerics rest on two dtype rules (PAPER.md §L0,
+docs/architecture.md): sample data is float32, accumulators (prefix
+sums, downsample reductions) are float64, and nothing may silently
+promote through numpy's float64 default or jax's weak types. The
+checks are scoped to the numeric core (``ops/`` and the engine/peaks
+device paths) where a silent dtype change is a *wrong numbers* bug,
+not a style issue:
+
+* array creation (``zeros`` / ``ones`` / ``empty`` / ``full`` on
+  np/jnp, plus ``jnp.arange``) must name its dtype — numpy's silent
+  float64 default either doubles the wire or downcasts later, and
+  which one happens depends on call-site luck;
+* ``cumsum`` (the accumulator primitive) must pass ``dtype=`` or
+  ``out=`` — the float64 accumulator rule made explicit at every site;
+* ``jnp.array`` / ``jnp.asarray`` of a Python literal must name its
+  dtype (weak-type promotion otherwise depends on what the value later
+  meets).
+"""
+import ast
+
+from .core import Analyzer, Finding
+
+__all__ = ["DtypeDisciplineAnalyzer", "SCOPE"]
+
+SCOPE_PREFIXES = ("riptide_tpu/ops/",)
+SCOPE = {
+    "riptide_tpu/search/engine.py",
+    "riptide_tpu/search/peaks_device.py",
+}
+
+_CREATE_MIN_ARGS = {"zeros": 2, "ones": 2, "empty": 2, "full": 3}
+_NP_NAMES = {"np", "numpy", "jnp", "onp"}
+
+
+def _np_call(node, attrs):
+    """The called attr name when ``node`` is ``np.<attr>(...)`` /
+    ``jnp.<attr>(...)`` with attr in ``attrs``; else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in attrs \
+            and isinstance(f.value, ast.Name) and f.value.id in _NP_NAMES:
+        return f.attr, f.value.id
+    return None
+
+
+def _has_dtype(node, min_args):
+    if len(node.args) >= min_args:
+        return True
+    return any(kw.arg in ("dtype", "out") for kw in node.keywords)
+
+
+def _literal_arg(node):
+    """True when the first argument is a Python literal (number, or a
+    list/tuple display) — the weak-type promotion case. Arrays passed
+    by name keep their dtype and are fine without one."""
+    if not node.args:
+        return False
+    a = node.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, (int, float)):
+        return True
+    return isinstance(a, (ast.List, ast.Tuple))
+
+
+class DtypeDisciplineAnalyzer(Analyzer):
+    rule = "RIP002"
+    name = "dtype-discipline"
+    description = ("float64 accumulator rule and explicit dtypes in the "
+                   "numeric core (ops/ and the engine/peaks paths)")
+
+    def __init__(self, scope=None, scope_prefixes=None):
+        self.scope = SCOPE if scope is None else scope
+        self.scope_prefixes = (SCOPE_PREFIXES if scope_prefixes is None
+                               else scope_prefixes)
+        self._seen_modules = set()
+
+    def begin(self, repo):
+        self._seen_modules = set()
+
+    def finalize(self, repo, contexts):
+        """Staleness guard on the explicitly-listed scope modules (the
+        prefix scopes track directory moves on their own)."""
+        return [
+            Finding(rel, 1, 0, self.rule,
+                    "scoped numeric-core module missing from the "
+                    "package — the dtype-discipline scope list "
+                    "(analysis/dtype_discipline.py SCOPE) is stale; "
+                    "update it")
+            for rel in sorted(set(self.scope) - self._seen_modules)
+        ]
+
+    def _in_scope(self, relpath):
+        return relpath in self.scope or any(
+            relpath.startswith(p) for p in self.scope_prefixes
+        )
+
+    def run(self, ctx):
+        if not self._in_scope(ctx.relpath):
+            return []
+        if ctx.relpath in self.scope:
+            self._seen_modules.add(ctx.relpath)
+        findings = []
+        for node in ast.walk(ctx.tree):
+            hit = _np_call(node, set(_CREATE_MIN_ARGS) | {"arange",
+                                                          "cumsum",
+                                                          "array",
+                                                          "asarray"})
+            if hit is None:
+                continue
+            attr, mod = hit
+            if attr in _CREATE_MIN_ARGS:
+                if not _has_dtype(node, _CREATE_MIN_ARGS[attr]):
+                    findings.append(Finding.at(
+                        ctx, node, self.rule,
+                        f"`{mod}.{attr}` without an explicit dtype in the "
+                        "numeric core — numpy defaults to float64 and "
+                        "jax to float32; name the dtype so the "
+                        "float32-data/float64-accumulator split is "
+                        "visible at the call site",
+                    ))
+            elif attr == "arange" and mod == "jnp":
+                if not _has_dtype(node, 99):
+                    findings.append(Finding.at(
+                        ctx, node, self.rule,
+                        "`jnp.arange` without an explicit dtype in the "
+                        "numeric core — index dtype must be pinned "
+                        "(int32 on device)",
+                    ))
+            elif attr == "cumsum":
+                if not _has_dtype(node, 99):
+                    findings.append(Finding.at(
+                        ctx, node, self.rule,
+                        f"`{mod}.cumsum` without `dtype=`/`out=` — the "
+                        "accumulator rule (float64 prefix sums) must be "
+                        "explicit at every reduction site",
+                    ))
+            elif attr in ("array", "asarray") and mod == "jnp":
+                if _literal_arg(node) and not _has_dtype(node, 2):
+                    findings.append(Finding.at(
+                        ctx, node, self.rule,
+                        f"`jnp.{attr}` of a Python literal without a "
+                        "dtype — weak-type promotion makes the result "
+                        "dtype depend on downstream context",
+                    ))
+        return findings

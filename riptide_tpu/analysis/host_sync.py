@@ -1,0 +1,180 @@
+"""
+RIP001 — host-sync detector.
+
+A single stray host synchronisation in the wrong place silently
+serialises the whole search: inside a jit-traced body it either breaks
+tracing or constant-folds a device transfer into the program; inside
+the engine/batcher *queueing* hot path it stalls the dispatch pipeline
+the queue-ahead design exists to keep full (PAPER.md's throughput
+posture; the wire/device overlap of search/engine.py).
+
+Two scopes, both precise by construction so the baseline stays small:
+
+* **jit bodies** — functions decorated with ``jax.jit`` /
+  ``partial(jax.jit, ...)`` / ``cached_jit(...)``: flags ``.item()``,
+  ``.tolist()``, ``.block_until_ready()``, ``jax.device_get``, numpy
+  pulls (``np.asarray`` / ``np.array`` / ``np.ascontiguousarray``) and
+  ``float()`` / ``int()`` on non-literal arguments (host round trips at
+  trace time);
+* **queueing hot paths** — the explicitly-listed enqueue-side functions
+  of the engine and batcher (collect/sync points are deliberately NOT
+  listed — syncing is their job): flags ``.item()``, ``.tolist()``,
+  ``.block_until_ready()``, ``jax.device_get`` and the numpy pulls.
+
+Intentional sync points (e.g. the one documented device pull of
+``run_periodogram``) live in the baseline with a justification.
+"""
+import ast
+
+from .core import Analyzer, Finding, call_name, dotted, walk_functions
+
+__all__ = ["HostSyncAnalyzer", "HOT_FUNCTIONS"]
+
+# Queue-side hot functions per module: these run between batches while
+# the device pipeline must stay fed, so a device->host pull here is a
+# throughput bug even when it is semantically harmless.
+HOT_FUNCTIONS = {
+    "riptide_tpu/search/engine.py": {
+        "_queue_stages", "queue_search_batch", "ship_stage_data",
+        "_run_stage_fused", "_run_stage_kernel", "_run_stage_gather",
+        "run_periodogram", "run_periodogram_batch",
+    },
+    "riptide_tpu/pipeline/batcher.py": {
+        "BatchSearcher.process_stream", "BatchSearcher._queue_chunk",
+        "BatchSearcher._queue_range", "BatchSearcher._ship_chunk",
+    },
+    "riptide_tpu/ops/ffa_kernel.py": {
+        "CycleKernel.run_fused", "CycleKernel.__call__",
+    },
+}
+
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+_NP_PULLS = {"asarray", "array", "ascontiguousarray"}
+_NP_NAMES = {"np", "numpy", "onp"}
+
+
+def _is_jit_decorated(fn):
+    """True for @jax.jit / @jit / @partial(jax.jit, ...) /
+    @functools.partial(jax.jit, ...) / @cached_jit(...)."""
+    for deco in fn.decorator_list:
+        name = dotted(deco) or ""
+        if name.split(".")[-1] in ("jit", "cached_jit"):
+            return True
+        if isinstance(deco, ast.Call):
+            cname = dotted(deco.func) or ""
+            if cname.split(".")[-1] in ("jit", "cached_jit"):
+                return True
+            if cname.split(".")[-1] == "partial" and deco.args:
+                inner = dotted(deco.args[0]) or ""
+                if inner.split(".")[-1] in ("jit", "cached_jit"):
+                    return True
+    return False
+
+
+def _np_pull(node):
+    """True for np.asarray/np.array/np.ascontiguousarray calls."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr in _NP_PULLS
+            and isinstance(f.value, ast.Name) and f.value.id in _NP_NAMES)
+
+
+def _literal(node):
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.operand,
+                                                    ast.Constant):
+        return True
+    return False
+
+
+class HostSyncAnalyzer(Analyzer):
+    rule = "RIP001"
+    name = "host-sync"
+    description = ("no host synchronisation inside jit-traced bodies or "
+                   "the engine/batcher queueing hot paths")
+
+    def __init__(self, hot_functions=None):
+        self.hot_functions = (HOT_FUNCTIONS if hot_functions is None
+                              else hot_functions)
+        self._seen_functions = {}
+
+    def _scan(self, ctx, fn, where, in_jit):
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _SYNC_ATTRS \
+                    and not node.args:
+                out.append(Finding.at(
+                    ctx, node, self.rule,
+                    f"`.{f.attr}()` forces a device sync inside {where} — "
+                    "keep the result on device or move the pull to the "
+                    "collect side",
+                ))
+            elif (dotted(f) or "").endswith("device_get"):
+                out.append(Finding.at(
+                    ctx, node, self.rule,
+                    f"`jax.device_get` inside {where} — device->host pull "
+                    "on the enqueue path",
+                ))
+            elif _np_pull(node):
+                out.append(Finding.at(
+                    ctx, node, self.rule,
+                    f"`{dotted(f)}` inside {where} materialises its "
+                    "argument on the host (a silent device sync when fed "
+                    "a device array)",
+                ))
+            elif in_jit and isinstance(f, ast.Name) \
+                    and f.id in ("float", "int") and len(node.args) == 1 \
+                    and not _literal(node.args[0]):
+                out.append(Finding.at(
+                    ctx, node, self.rule,
+                    f"`{f.id}(...)` on a traced value inside {where} "
+                    "breaks tracing (or constant-folds a host round "
+                    "trip) — use jnp casts or static arguments",
+                ))
+        return out
+
+    def begin(self, repo):
+        self._seen_functions = {}
+
+    def run(self, ctx):
+        findings = []
+        hot = self.hot_functions.get(ctx.relpath, set())
+        seen = self._seen_functions.setdefault(ctx.relpath, set())
+        for qual, fn in walk_functions(ctx.tree):
+            seen.add(qual)
+            if _is_jit_decorated(fn):
+                findings.extend(self._scan(
+                    ctx, fn, f"jit body `{qual}`", in_jit=True))
+            elif qual in hot:
+                findings.extend(self._scan(
+                    ctx, fn, f"queueing hot path `{qual}`", in_jit=False))
+        return findings
+
+    def finalize(self, repo, contexts):
+        """Staleness guard on the scope config: a renamed module or hot
+        function must fail the lint loudly, not silently unscope it."""
+        findings = []
+        for rel, names in sorted(self.hot_functions.items()):
+            seen = self._seen_functions.get(rel)
+            if seen is None:
+                findings.append(Finding(
+                    rel, 1, 0, self.rule,
+                    "hot-path module missing from the package — the "
+                    "host-sync scope list (analysis/host_sync.py "
+                    "HOT_FUNCTIONS) is stale; update it",
+                ))
+                continue
+            for name in sorted(set(names) - seen):
+                findings.append(Finding(
+                    rel, 1, 0, self.rule,
+                    f"hot-path function {name!r} no longer exists in "
+                    "this module — the host-sync scope list "
+                    "(analysis/host_sync.py HOT_FUNCTIONS) is stale; "
+                    "update it or the queueing path goes unchecked",
+                ))
+        return findings

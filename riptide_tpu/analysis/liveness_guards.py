@@ -1,0 +1,165 @@
+"""
+RIP007 — bounded-collective discipline (ported from
+``tools/check_liveness_guards.py``, which remains as a thin shim).
+
+Every ``multihost_utils`` collective call site in ``riptide_tpu/``
+goes through the liveness layer's bounded-wait wrappers
+(``bounded_allgather`` / ``barrier_with_timeout``), so a dead peer
+cannot deadlock the run; import bindings that would evade the
+attribute check are violations at the import itself, and ZERO wrapped
+call sites means the wrappers were refactored away and the lint has
+gone vacuous (also a failure). Same AST logic as the original tool,
+now emitting framework findings.
+"""
+import ast
+import os
+
+from .core import Analyzer, Finding
+
+__all__ = ["LivenessGuardAnalyzer", "ALLOWED", "check_file", "check"]
+
+# relpath -> function names allowed to call multihost_utils
+ALLOWED = {
+    "riptide_tpu/survey/liveness.py":
+        {"bounded_allgather", "barrier_with_timeout"},
+}
+
+_WRAPPER_HOME = "riptide_tpu/survey/liveness.py"
+
+
+def _is_multihost_attr(node):
+    """True for an attribute access rooted at a name (or attribute)
+    called ``multihost_utils`` — covers ``multihost_utils.x`` and
+    ``jax.experimental.multihost_utils.x``."""
+    if not isinstance(node, ast.Attribute):
+        return False
+    v = node.value
+    if isinstance(v, ast.Name):
+        return v.id == "multihost_utils"
+    if isinstance(v, ast.Attribute):
+        return v.attr == "multihost_utils"
+    return False
+
+
+def _call_sites(tree):
+    """Sites that can reach a collective, as ``(lineno, enclosing
+    function name or None, kind)`` — see the original tool's docstring
+    for the call/import taxonomy."""
+    sites = []
+
+    def visit(node, fn):
+        name = fn
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            name = node.name
+        if isinstance(node, ast.Call) and _is_multihost_attr(node.func):
+            sites.append((node.lineno, name, "call"))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module \
+                    and node.module.split(".")[-1] == "multihost_utils":
+                sites.append((node.lineno, name, "import"))
+            else:
+                for a in node.names:
+                    if a.name == "multihost_utils" and a.asname not in (
+                            None, "multihost_utils"):
+                        sites.append((node.lineno, name, "import"))
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.split(".")[-1] == "multihost_utils" \
+                        and a.asname is not None:
+                    sites.append((node.lineno, name, "import"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, name)
+
+    visit(tree, None)
+    return sites
+
+
+def check_tree(tree, rel, allowed):
+    """Structured violations for one parsed module: ``(violations,
+    wrapped)`` where violations are ``(lineno, message)`` and
+    ``wrapped`` counts collective calls inside allowed wrappers."""
+    violations, wrapped = [], 0
+    for lineno, fn, kind in _call_sites(tree):
+        if fn is not None and fn in allowed.get(rel, ()):
+            if kind == "call":
+                wrapped += 1
+            continue
+        what = ("raw multihost_utils collective" if kind == "call"
+                else "multihost_utils import that evades the call check")
+        violations.append((
+            lineno,
+            f"{what} "
+            f"{'in ' + fn + '()' if fn else 'at module level'} — route it "
+            "through riptide_tpu.survey.liveness (bounded_allgather / "
+            "barrier_with_timeout) so a dead peer cannot deadlock the run",
+        ))
+    return violations, wrapped
+
+
+def check_file(path, rel, allowed):
+    """Back-compat string API; second return value counts call sites
+    inside allowed wrappers."""
+    with open(path) as fobj:
+        tree = ast.parse(fobj.read(), filename=path)
+    violations, wrapped = check_tree(tree, rel, allowed)
+    return [f"{rel}:{lineno}: {msg}" for lineno, msg in violations], wrapped
+
+
+VACUOUS_MESSAGE = (
+    "no multihost_utils call found inside the allowed liveness "
+    "wrappers — the lint has gone vacuous (were "
+    "bounded_allgather/barrier_with_timeout refactored away? "
+    "update the liveness-guard allowlist)"
+)
+
+
+def check(repo, allowed=None):
+    """All violations (strings) across ``riptide_tpu/``;
+    vacuous-lint guard included."""
+    allowed = ALLOWED if allowed is None else allowed
+    # Accept OS-path keys too (the original tool used os.path.join).
+    allowed = {k.replace(os.sep, "/"): v for k, v in allowed.items()}
+    package = os.path.join(repo, "riptide_tpu")
+    violations, wrapped_total = [], 0
+    for dirpath, dirnames, filenames in os.walk(package):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, repo).replace(os.sep, "/")
+            v, wrapped = check_file(path, rel, allowed)
+            violations.extend(v)
+            wrapped_total += wrapped
+    if wrapped_total == 0:
+        violations.append(VACUOUS_MESSAGE)
+    return violations
+
+
+class LivenessGuardAnalyzer(Analyzer):
+    rule = "RIP007"
+    name = "liveness-guards"
+    description = ("multihost_utils collectives route through the "
+                   "liveness layer's bounded-wait wrappers")
+
+    def __init__(self, allowed=None):
+        self.allowed = ALLOWED if allowed is None else allowed
+        self._wrapped = 0
+
+    def begin(self, repo):
+        self._wrapped = 0
+
+    def run(self, ctx):
+        violations, wrapped = check_tree(ctx.tree, ctx.relpath,
+                                         self.allowed)
+        self._wrapped += wrapped
+        return [
+            Finding(ctx.relpath, lineno, 0, self.rule, msg)
+            for lineno, msg in violations
+        ]
+
+    def finalize(self, repo, contexts):
+        if self._wrapped == 0:
+            return [Finding(_WRAPPER_HOME, 1, 0, self.rule,
+                            VACUOUS_MESSAGE)]
+        return []

@@ -1,0 +1,219 @@
+"""
+RIP005 — Pallas kernel layout discipline.
+
+Mosaic kernels fail in uniquely unpleasant ways when their launch
+geometry is sloppy: a dynamic shape reaching a ``BlockSpec`` or
+``grid`` retraces (or miscompiles) per call; an implicit memory space
+lets a scratch land in the wrong one silently; Python-side
+nondeterminism (time, random, environment) captured into a kernel
+closure bakes an unreproducible constant into a cached executable —
+the exact failure class KERNEL_CACHE_VERSION exists to prevent.
+
+Scoped to modules that import ``jax.experimental.pallas``. Checks:
+
+* every ``pl.BlockSpec(...)`` names ``memory_space=`` explicitly;
+* every ``pl.pallas_call(...)`` passes ``out_shape=`` and a ``grid=``
+  or ``grid_spec=``;
+* shape positions (``BlockSpec`` block shapes, ``grid=`` tuples —
+  including inside a ``grid_spec=PrefetchScalarGridSpec(...)``) hold
+  static expressions: names, constants and arithmetic only, no calls;
+* kernel bodies (the function handed to ``pallas_call``, plus every
+  module function reachable from it) are free of host nondeterminism:
+  ``time.*``, ``random.*``, ``np.random.*``, ``os.environ`` /
+  ``os.getenv``, ``hash()``, ``id()``, ``datetime.*``.
+"""
+import ast
+
+from .core import Analyzer, Finding, dotted, walk_functions
+
+__all__ = ["PallasLayoutAnalyzer"]
+
+_NONDET_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                    "datetime.", "os.environ", "os.getenv")
+_NONDET_BARE = {"hash", "id", "getenv"}
+
+
+def _imports_pallas(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and "pallas" in node.module:
+                return True
+            if any("pallas" in a.name for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any("pallas" in a.name for a in node.names):
+                return True
+    return False
+
+
+def _calls_in_shape(node):
+    """Call nodes appearing inside a shape/grid expression (dynamic
+    geometry), ignoring lambdas (index maps are callables by
+    contract)."""
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Lambda):
+            return out  # index map: its body is not a shape
+        if isinstance(sub, ast.Call):
+            out.append(sub)
+    return out
+
+
+def _kw(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class PallasLayoutAnalyzer(Analyzer):
+    rule = "RIP005"
+    name = "pallas-layout"
+    description = ("static BlockSpec/grid shapes, explicit memory "
+                   "spaces, and nondeterminism-free kernel closures in "
+                   "Pallas modules")
+
+    def run(self, ctx):
+        if not _imports_pallas(ctx.tree):
+            return []
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            leaf = name.split(".")[-1]
+            if leaf == "BlockSpec":
+                if _kw(node, "memory_space") is None:
+                    findings.append(Finding.at(
+                        ctx, node, self.rule,
+                        "`BlockSpec` without an explicit `memory_space=` "
+                        "— where a block lives (VMEM/SMEM/ANY) is part "
+                        "of the kernel contract, not a default",
+                    ))
+                for pos in node.args[:1]:  # block shape
+                    for call in _calls_in_shape(pos):
+                        findings.append(Finding.at(
+                            ctx, call, self.rule,
+                            "dynamic expression in a `BlockSpec` block "
+                            "shape — shapes must be static (hoist the "
+                            "value into a build-key parameter)",
+                        ))
+            elif leaf == "pallas_call":
+                if _kw(node, "out_shape") is None:
+                    findings.append(Finding.at(
+                        ctx, node, self.rule,
+                        "`pallas_call` without `out_shape=` — output "
+                        "geometry must be explicit",
+                    ))
+                grid = _kw(node, "grid")
+                grid_spec = _kw(node, "grid_spec")
+                if grid is None and grid_spec is None:
+                    findings.append(Finding.at(
+                        ctx, node, self.rule,
+                        "`pallas_call` without `grid=`/`grid_spec=` — "
+                        "launch geometry must be explicit",
+                    ))
+                if grid is not None:
+                    for call in _calls_in_shape(grid):
+                        findings.append(Finding.at(
+                            ctx, call, self.rule,
+                            "dynamic expression in `grid=` — the launch "
+                            "grid must be static",
+                        ))
+            elif leaf in ("PrefetchScalarGridSpec", "GridSpec"):
+                grid = _kw(node, "grid")
+                if grid is not None:
+                    for call in _calls_in_shape(grid):
+                        findings.append(Finding.at(
+                            ctx, call, self.rule,
+                            "dynamic expression in a grid spec's "
+                            "`grid=` — the launch grid must be static",
+                        ))
+        findings.extend(self._check_kernel_closures(ctx))
+        return findings
+
+    # -- nondeterminism in kernel closures ------------------------------
+
+    def _kernel_roots(self, ctx):
+        """Names of functions handed to pallas_call (directly, or as
+        the first argument of a functools.partial bound to the variable
+        passed in)."""
+        roots = set()
+        partials = {}  # var name -> partial'd function name
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                cname = dotted(node.value.func) or ""
+                if cname.split(".")[-1] == "partial" and node.value.args:
+                    inner = dotted(node.value.args[0])
+                    if inner and len(node.targets) == 1 \
+                            and isinstance(node.targets[0], ast.Name):
+                        partials[node.targets[0].id] = inner
+            if isinstance(node, ast.Call):
+                name = dotted(node.func) or ""
+                if name.split(".")[-1] == "pallas_call" and node.args:
+                    a = node.args[0]
+                    if isinstance(a, ast.Name):
+                        roots.add(partials.get(a.id, a.id))
+                    elif isinstance(a, ast.Call):
+                        cname = dotted(a.func) or ""
+                        if cname.split(".")[-1] == "partial" and a.args:
+                            inner = dotted(a.args[0])
+                            if inner:
+                                roots.add(inner)
+        return roots
+
+    def _check_kernel_closures(self, ctx):
+        functions = dict(walk_functions(ctx.tree))
+        by_leaf = {}
+        for qual, fn in functions.items():
+            by_leaf.setdefault(qual.split(".")[-1], fn)
+        # Transitive closure over module-level function calls.
+        reach = set()
+        frontier = [r for r in self._kernel_roots(ctx) if r in by_leaf]
+        while frontier:
+            name = frontier.pop()
+            if name in reach:
+                continue
+            reach.add(name)
+            for node in ast.walk(by_leaf[name]):
+                if isinstance(node, ast.Call):
+                    callee = (dotted(node.func) or "").split(".")[-1]
+                    if callee in by_leaf and callee not in reach:
+                        frontier.append(callee)
+        findings = []
+        seen = set()
+        for name in sorted(reach):
+            for node in ast.walk(by_leaf[name]):
+                loc = (getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0))
+                if loc in seen:
+                    continue
+                expr = dotted(node) if isinstance(node,
+                                                  ast.Attribute) else None
+                if isinstance(node, ast.Call):
+                    cname = dotted(node.func) or ""
+                    bad = (any(cname.startswith(p)
+                               for p in _NONDET_PREFIXES)
+                           or cname in _NONDET_BARE)
+                    if bad:
+                        seen.add(loc)
+                        findings.append(Finding.at(
+                            ctx, node, self.rule,
+                            f"host nondeterminism (`{cname}`) inside "
+                            f"kernel closure `{name}` — a cached "
+                            "executable would bake this value in "
+                            "(KERNEL_CACHE_VERSION cannot see it)",
+                        ))
+                elif expr and any(expr.startswith(p)
+                                  for p in ("os.environ", "time.",
+                                            "random.")):
+                    seen.add(loc)
+                    findings.append(Finding.at(
+                        ctx, node, self.rule,
+                        f"host state read (`{expr}`) inside kernel "
+                        f"closure `{name}` — kernel bodies must be pure "
+                        "functions of their operands and static "
+                        "parameters",
+                    ))
+        return findings

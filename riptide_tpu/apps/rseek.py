@@ -120,6 +120,7 @@ def _search_with_survey_hooks(args, ts):
     record of the completed unit."""
     import os
 
+    from riptide_tpu.utils import envflags
     from riptide_tpu.survey.faults import FaultPlan
     from riptide_tpu.survey.journal import SurveyJournal
     from riptide_tpu.survey.metrics import get_metrics
@@ -148,7 +149,7 @@ def _search_with_survey_hooks(args, ts):
                 return done[0][1]
 
     faults = FaultPlan.parse(args.fault_inject
-                             or os.environ.get("RIPTIDE_FAULT_INJECT"))
+                             or envflags.get("RIPTIDE_FAULT_INJECT"))
     # nan_inject directives corrupt the loaded samples BEFORE the
     # data-quality scan inside ffa_search, exercising the masking path.
     faults.nan_inject(0, ts.data)

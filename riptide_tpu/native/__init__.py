@@ -15,6 +15,8 @@ import threading
 import numpy as np
 from numpy.ctypeslib import ndpointer
 
+from ..utils import envflags
+
 log = logging.getLogger("riptide_tpu.native")
 
 __all__ = [
@@ -46,18 +48,38 @@ _BUILD_DIR = os.path.join(_HERE, "_build")
 # baseline x86-64 has no FMA but aarch64 GCC defaults to
 # -ffp-contract=fast with hardware FMA, which would silently break the
 # wire byte-parity the block scales and tests depend on.
-_FLAGS = ("-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-          "-ffp-contract=off")
+_BASE_FLAGS = ("-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               "-ffp-contract=off")
+# Sanitizer flavor (RIPTIDE_NATIVE_SANITIZE=1, `make native-asan`):
+# ASan + UBSan with recovery disabled, so ANY report aborts the run —
+# "tests pass under the sanitizer" then means "zero reports", not
+# "reports scrolled by". -ffp-contract=off stays, so the sanitized .so
+# keeps the same wire byte-parity contract the tests assert. The
+# sanitized library only loads when libasan/libubsan are preloaded
+# (the Makefile targets set LD_PRELOAD); without them CDLL fails and
+# consumers fall back to numpy as usual.
+_SAN_FLAGS = ("-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+              "-g", "-fno-omit-frame-pointer")
+
+
+def _flags():
+    base = _BASE_FLAGS
+    if envflags.get("RIPTIDE_NATIVE_SANITIZE"):
+        base = base + _SAN_FLAGS
+    return base
 
 
 def _flags_tag():
     import hashlib
 
-    # Stable across processes (unlike hash(), which PYTHONHASHSEED salts).
-    return hashlib.sha1(" ".join(_FLAGS).encode()).hexdigest()[:8]
+    # Stable across processes (unlike hash(), which PYTHONHASHSEED
+    # salts). The flags are part of the cache key, so the sanitized
+    # flavor builds to its own .so next to the production one.
+    return hashlib.sha1(" ".join(_flags()).encode()).hexdigest()[:8]
 
 
-_LIB_PATH = os.path.join(_BUILD_DIR, f"libriptide_native_{_flags_tag()}.so")
+def _lib_path():
+    return os.path.join(_BUILD_DIR, f"libriptide_native_{_flags_tag()}.so")
 
 _lock = threading.Lock()
 _lib = None
@@ -81,10 +103,10 @@ def _build():
     # No -march=native: the cached .so may be reused from a shared
     # filesystem by hosts with a narrower ISA, where native-tuned code
     # dies with SIGILL outside the reach of the numpy-fallback handler.
-    cmd = ["g++", *_FLAGS, _SRC, "-o", tmp_path]
+    cmd = ["g++", *_flags(), _SRC, "-o", tmp_path]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(tmp_path, _LIB_PATH)
+        os.replace(tmp_path, _lib_path())
     except subprocess.CalledProcessError as err:
         # str(CalledProcessError) omits stderr; surface the compiler
         # diagnostics or build failures are undebuggable.
@@ -172,13 +194,14 @@ def _get():
             return _lib
         _tried = True
         try:
+            lib_path = _lib_path()
             stale = (
-                not os.path.exists(_LIB_PATH)
-                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+                not os.path.exists(lib_path)
+                or os.path.getmtime(lib_path) < os.path.getmtime(_SRC)
             )
             if stale:
                 _build()
-            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+            _lib = _bind(ctypes.CDLL(lib_path))
         except Exception as err:
             log.warning(f"native library unavailable ({err}); using numpy fallbacks")
             _lib = None

@@ -41,7 +41,8 @@ def split_prefix_sums(data):
     in float64 and split into (hi, lo) float32 arrays with
     hi + lo ~= exact sum. Length is ``data.size + 1``.
     """
-    cs = np.concatenate(([0.0], np.cumsum(np.asarray(data, dtype=np.float64))))
+    cs = np.concatenate(([0.0], np.cumsum(np.asarray(data, dtype=np.float64),
+                                          dtype=np.float64)))
     hi = cs.astype(np.float32)
     lo = (cs - hi).astype(np.float32)
     return hi, lo
@@ -65,9 +66,10 @@ def downsample_plan_padded(nsamp, f, nout):
     imax = np.concatenate([imax, np.zeros(pad, np.int64)]).astype(np.int32)
     # wint masks the interior prefix-sum term so padding rows are exactly 0
     # (their boundary weights are already 0).
-    wmin = np.concatenate([wmin, np.zeros(pad)]).astype(np.float32)
-    wmax = np.concatenate([wmax, np.zeros(pad)]).astype(np.float32)
-    wint = np.concatenate([np.ones(n), np.zeros(pad)]).astype(np.float32)
+    wmin = np.concatenate([wmin, np.zeros(pad, np.float64)]).astype(np.float32)
+    wmax = np.concatenate([wmax, np.zeros(pad, np.float64)]).astype(np.float32)
+    wint = np.concatenate([np.ones(n, np.float64),
+                           np.zeros(pad, np.float64)]).astype(np.float32)
     return imin, imax, wmin, wmax, wint
 
 

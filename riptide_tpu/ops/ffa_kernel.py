@@ -42,6 +42,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 log = logging.getLogger("riptide_tpu.ffa_kernel")
 
+from ..utils import envflags
 from ..utils.compat import pallas_compiler_params
 from .slottables import (A_SHIFT, A_BITS, B_SHIFT, B_BITS, NAT_LEVELS,
                          PH_BITS, PH_MASK, build_tables)
@@ -172,7 +173,7 @@ def tables_resident(L, NL, rows, P, fused_mode=None, PW=None):
     size cap (larger scratches crash the Mosaic compiler — deeper
     buckets stream tables level-by-level as before).
     RIPTIDE_KERNEL_RESIDENT=0 forces streaming everywhere."""
-    if os.environ.get("RIPTIDE_KERNEL_RESIDENT") == "0":
+    if not envflags.get("RIPTIDE_KERNEL_RESIDENT"):
         return False
     ntab = num_level_tables(L, NL) + (1 if fused_mode else 0)
     tab_bytes = ntab * rows * 128 * 4
@@ -583,9 +584,8 @@ def _exec_dir():
     if _EXEC_DIR is None:
         from ..utils.exec_cache import cache_root
 
-        _EXEC_DIR = os.environ.get(
-            "RIPTIDE_KERNEL_CACHE", os.path.join(cache_root(), "kernel")
-        )
+        _EXEC_DIR = (envflags.get("RIPTIDE_KERNEL_CACHE")
+                     or os.path.join(cache_root(), "kernel"))
     return _EXEC_DIR
 
 
@@ -629,7 +629,7 @@ class _CachedCall:
                 tpu = jax.default_backend() in ("tpu", "axon")
             except RuntimeError:
                 tpu = False
-            if not tpu or os.environ.get("RIPTIDE_KERNEL_CACHE") == "off":
+            if not tpu or envflags.get("RIPTIDE_KERNEL_CACHE") == "off":
                 self._fn = self.jitted
                 self.source = "jit"
                 return
@@ -818,7 +818,7 @@ class CycleKernel:
         # Base-3 (1.5 * 2**k) containers serve buckets whose largest
         # problem fits, cutting the power-of-two padding waste by ~25%
         # on affected stages; RIPTIDE_KERNEL_BASE3=0 forces 2**L.
-        if os.environ.get("RIPTIDE_KERNEL_BASE3") == "0":
+        if not envflags.get("RIPTIDE_KERNEL_BASE3"):
             rows = 1 << L
         else:
             rows = container_rows(max(ms), L)

@@ -293,7 +293,8 @@ def periodogram_ref(data, tsamp, widths, period_min, period_max, bins_min, bins_
             foldbins.append(np.full(rows_eval, bins, np.uint32))
     nw = widths.size
     if not periods:
-        return np.empty(0), np.empty(0, np.uint32), np.empty((0, nw), np.float32)
+        return (np.empty(0, np.float64), np.empty(0, np.uint32),
+                np.empty((0, nw), np.float32))
     return (
         np.concatenate(periods),
         np.concatenate(foldbins),

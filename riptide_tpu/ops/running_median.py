@@ -55,10 +55,13 @@ def fast_running_median_jax(x, width, min_points=101):
     the reference exactly (sample k of the scrunched series sits at
     original coordinate k*factor + (factor-1)/2).
     """
-    factor = int(max(1, width / float(min_points)))
+    # width/min_points are static_argnums: host arithmetic on trace-time
+    # constants, not a sync on a traced value.
+    factor = int(max(1, width / float(min_points)))  # riplint: disable=RIP001
     if factor == 1:
         return running_median_jax(x, width)
     lo = scrunch_jax(x, factor)
     rmed_lo = running_median_jax(lo, min_points)
-    x_lo = jnp.arange(lo.shape[0]) * factor + 0.5 * (factor - 1)
+    x_lo = jnp.arange(lo.shape[0], dtype=jnp.int32) * factor \
+        + 0.5 * (factor - 1)
     return jnp.interp(jnp.arange(x.shape[0], dtype=jnp.float32), x_lo, rmed_lo)

@@ -67,7 +67,7 @@ def leaf_rows(m, L):
     """Natural input-row index held by each of the 2**L leaf slots
     (-1 for empty slots): the exclusive cumsum of leaf sizes."""
     sz = node_sizes(m, L)
-    r0 = np.concatenate(([0], np.cumsum(sz)[:-1]))
+    r0 = np.concatenate(([0], np.cumsum(sz, dtype=np.int64)[:-1]))
     return np.where(sz > 0, r0, -1).astype(np.int64)
 
 

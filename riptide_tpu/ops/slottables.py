@@ -141,7 +141,10 @@ def build_tables(m, p, L=None, R=None):
         d = L - l
         sizes = node_sizes(m, d)
         csizes = node_sizes(m, d + 1)
-        r0 = np.concatenate(([0], np.cumsum(sizes)[:-1]))
+        # dtype already int64 (node_sizes); left implicit because this
+        # body is covered by the KERNEL_CACHE_VERSION bytecode digest
+        # and a no-op edit must not force a cache-version bump.
+        r0 = np.concatenate(([0], np.cumsum(sizes)[:-1]))  # riplint: disable=RIP002
         sig = np.zeros(rows, np.int64)
         dh = np.zeros(rows, np.int64)
         bb = np.zeros(rows, np.int64)

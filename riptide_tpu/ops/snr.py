@@ -78,7 +78,9 @@ def snr_batched(tbuf, p, widths, hcoef, bcoef, stdnoise):
     """
     quality.check_finite_array(tbuf, where="ops.snr.snr_batched")
     B, R, P = tbuf.shape
-    cs = jnp.cumsum(tbuf, axis=-1)
+    # float32 by design: this is the device S/N path, matching the
+    # Pallas kernel's in-VMEM float32 prefix sum bit for bit.
+    cs = jnp.cumsum(tbuf, axis=-1, dtype=jnp.float32)
     total = cs[..., -1:]
     pb = p[:, None, None]
     outs = []
@@ -93,11 +95,12 @@ def snr_batched(tbuf, p, widths, hcoef, bcoef, stdnoise):
 @partial(jax.jit, static_argnums=(2,))
 def _boxcar_snr_2d(data, coeffs, widths):
     m, p = data.shape
-    cs = jnp.cumsum(data, axis=-1)
+    cs = jnp.cumsum(data, axis=-1, dtype=jnp.float32)
     total = cs[..., -1:]
     outs = []
     for iw, w in enumerate(widths):
-        dmax = _snr_one_width(cs, total, p, int(w), p)
+        # widths is a static_argnums tuple: trace-time host arithmetic.
+        dmax = _snr_one_width(cs, total, p, int(w), p)  # riplint: disable=RIP001
         outs.append((coeffs[iw, 0] + coeffs[iw, 1]) * dmax - coeffs[iw, 1] * total[..., 0])
     return jnp.stack(outs, axis=-1)
 

@@ -31,6 +31,7 @@ from ..clustering import cluster1d
 from ..serialization import save_json
 from ..survey.faults import FaultPlan
 from ..timing import maybe_trace, timing
+from ..utils import envflags
 from .batcher import BatchSearcher
 from .config_validation import validate_pipeline_config, validate_ranges
 from .dmiter import DMIterator
@@ -129,7 +130,7 @@ class Pipeline:
         self.journal_dir = journal
         self.resume = bool(resume)
         self.fault_spec = (fault_spec if fault_spec is not None
-                           else os.environ.get("RIPTIDE_FAULT_INJECT"))
+                           else envflags.get("RIPTIDE_FAULT_INJECT"))
         # ONE fault plan shared by the scheduler (raise/stall/abort/
         # corrupt/hang/straggle kinds) and the batch searcher
         # (nan_inject/oom kinds), so directive budgets are consumed

@@ -24,7 +24,6 @@ Replaces the reference's single-threaded C++ search loop
 parallelism (riptide/pipeline/worker_pool.py) with one SPMD program.
 """
 import logging
-import os
 import time
 from functools import partial
 
@@ -36,6 +35,7 @@ log = logging.getLogger("riptide_tpu.search.engine")
 
 from ..ops.downsample import downsample_gather, split_prefix_sums
 from ..survey.metrics import get_metrics
+from ..utils import envflags
 from ..utils.exec_cache import cached_jit
 from ..ops.ffa import ffa_levels
 from ..ops.ffa_kernel import NWPAD
@@ -178,7 +178,7 @@ def _prefix64(data):
         tail = np.concatenate(
             [cs[..., 4 * nv : 4 * nv + 1], data[..., 4 * nv :]], axis=-1
         )
-        cs[..., 4 * nv :] = np.cumsum(tail, axis=-1)
+        cs[..., 4 * nv :] = np.cumsum(tail, axis=-1, dtype=np.float64)
     return data, cs
 
 
@@ -307,11 +307,8 @@ def _wire_mode(path):
     the XLA pack path. Override with
     RIPTIDE_WIRE_DTYPE=float32|float16|uint12|uint8|uint6.
     """
-    mode = os.environ.get("RIPTIDE_WIRE_DTYPE")
+    mode = envflags.get("RIPTIDE_WIRE_DTYPE")
     if mode:
-        mode = {"u12": "uint12", "u8": "uint8", "u6": "uint6"}.get(mode, mode)
-        if mode not in ("float32", "float16", "uint12", "uint8", "uint6"):
-            raise ValueError(f"unsupported RIPTIDE_WIRE_DTYPE={mode!r}")
         return mode
     return "uint6" if path == "kernel" else "float32"
 
@@ -354,8 +351,10 @@ def _view_layout(plan, mode):
     r0s = [-(-st.n // PW) for st in plan.stages]
     prs = [-(-r0 // group) for r0 in r0s]
     wrows = [planes * pr for pr in prs]
-    roffs = np.concatenate([[0], np.cumsum(wrows)]).astype(np.int64)
-    soffs = np.concatenate([[0], np.cumsum(r0s)]).astype(np.int64)
+    roffs = np.concatenate([[0], np.cumsum(wrows,
+                                           dtype=np.int64)]).astype(np.int64)
+    soffs = np.concatenate([[0], np.cumsum(r0s,
+                                           dtype=np.int64)]).astype(np.int64)
     # Scale-DMA extent bound: the kernel reads group * _prcap(rows)
     # scale rows per stage; bound rows by the stage's full-bucket
     # container (lane-split buckets are never taller). The 2^L form is
@@ -385,7 +384,8 @@ def _wire_layout(plan, mode):
         vl = _view_layout(plan, mode)
         return vl["roffs"], vl["wrows"], vl["tot_rows"]
     lens = [st.n for st in plan.stages]
-    offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+    offs = np.concatenate([[0], np.cumsum(lens,
+                                          dtype=np.int64)]).astype(np.int64)
     return offs[:-1], lens, int(offs[-1])
 
 
@@ -541,7 +541,7 @@ def _ffa_path():
     """'kernel' | 'gather', from RIPTIDE_FFA_PATH (auto = kernel on TPU
     backends — incl. the axon tunnel — gather elsewhere: the Mosaic
     kernel cannot lower on CPU/GPU)."""
-    mode = os.environ.get("RIPTIDE_FFA_PATH", "auto")
+    mode = envflags.get("RIPTIDE_FFA_PATH")
     if mode in ("kernel", "gather"):
         return mode
     try:
@@ -561,7 +561,7 @@ def _bucket_shape(st, idx):
     ps = [st.ps_padded[i] for i in idx]
     L = max(num_levels(m) for m in ms)
     NL = min(L, NAT_LEVELS)
-    if os.environ.get("RIPTIDE_KERNEL_BASE3") == "0":
+    if not envflags.get("RIPTIDE_KERNEL_BASE3"):
         rows = 1 << L
     else:
         rows = container_rows(max(ms), L)
@@ -968,7 +968,7 @@ def run_search_batch(plan, batch, tobs, dms=None, prepared=None,
     if dms is None:
         if D is None:
             D = search_snr_dev(handle).shape[0]
-        dms = np.zeros(D)
+        dms = np.zeros(D, np.float64)
     return collect_search_batch(handle, dms)
 
 

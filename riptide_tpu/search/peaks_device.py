@@ -96,7 +96,8 @@ class PeakPlan:
         seg = snr[:, : self.nseg * self.pts, :]
         D, _, NW = seg.shape
         seg = seg.transpose(0, 2, 1).reshape(D, NW, self.nseg, self.pts)
-        q = jnp.percentile(seg, jnp.asarray([25.0, 50.0, 75.0]), axis=-1)
+        q = jnp.percentile(seg, jnp.asarray([25.0, 50.0, 75.0],
+                                            dtype=jnp.float32), axis=-1)
         return q.transpose(1, 2, 3, 0)  # (D, NW, nseg, 3)
 
     @cached_jit(static_argnames=("self",))
@@ -112,7 +113,7 @@ class PeakPlan:
         smed + nstd * (IQR / 1.349); static-smin fallback when the
         segment count is below minseg (riptide/peak_detection.py:126)."""
         D, NW = stats.shape[:2]
-        polyco = np.zeros((D, NW, self.polydeg + 1))
+        polyco = np.zeros((D, NW, self.polydeg + 1), np.float64)
         s25 = stats[..., 0].astype(np.float64)
         smed = stats[..., 1].astype(np.float64)
         s75 = stats[..., 2].astype(np.float64)
@@ -187,7 +188,8 @@ class PeakPlan:
         cnt = self._counts_impl(snr, coef)              # (D, NW, nb)
         nb, BLK, CAP = self._nb, self.BLK, self.CAP
         nz = cnt > 0
-        rank = jnp.cumsum(nz.astype(jnp.int32), axis=-1) - 1
+        rank = jnp.cumsum(nz.astype(jnp.int32), axis=-1,
+                          dtype=jnp.int32) - 1
         oh = (nz & (rank < CAP))[..., None] & (
             rank[..., None] == jnp.arange(CAP, dtype=jnp.int32)
         )                                               # (D, NW, nb, CAP)
@@ -216,7 +218,7 @@ class PeakPlan:
                                   self._nb, self.CAP, self.BLK)
         sizes = [D * NW * nseg * 3, D * NW * nb, D * NW * CAP,
                  D * NW * CAP * BLK]
-        offs = np.concatenate([[0], np.cumsum(sizes)])
+        offs = np.concatenate([[0], np.cumsum(sizes, dtype=np.int64)])
         stats = buf[offs[0]:offs[1]].reshape(D, NW, nseg, 3)
         cnt = buf[offs[1]:offs[2]].astype(np.int32).reshape(D, NW, nb)
         ids = buf[offs[2]:offs[3]].astype(np.int32).reshape(D, NW, CAP)

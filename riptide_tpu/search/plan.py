@@ -25,7 +25,6 @@ XLA retraces to O(log(m_max)) per search configuration.
 """
 from functools import lru_cache
 import math
-import os
 
 import numpy as np
 
@@ -33,6 +32,7 @@ from ..ops.plan import FFABatchPlan
 from ..ops.reference import downsampled_size, downsampled_variance
 from ..ops.snr import boxcar_coeffs
 from ..ops.downsample import downsample_plan_padded
+from ..utils import envflags
 
 __all__ = ["PeriodogramPlan", "periodogram_plan", "check_arguments", "ceilshift"]
 
@@ -165,7 +165,7 @@ class CycleStage:
         Bucket membership depends only on the bins list, which is
         identical for every stage of a plan, so bucket B counts — and
         therefore compiled-kernel shapes — are shared across stages."""
-        split = os.environ.get("RIPTIDE_KERNEL_LANE_SPLIT", "1") != "0"
+        split = envflags.get("RIPTIDE_KERNEL_LANE_SPLIT")
         cached = getattr(self, "_lane_buckets", None)
         if cached is not None and cached[0] == split:
             return cached[1]

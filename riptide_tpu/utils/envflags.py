@@ -1,0 +1,243 @@
+"""
+Typed registry of every ``RIPTIDE_*`` environment flag.
+
+Every environment flag the package reads is declared here once — name,
+type, default, effect, and the PR that introduced it — and read through
+:func:`get`, which parses and validates the raw string at call time (so
+tests that monkeypatch ``os.environ`` keep working). Direct
+``os.environ`` reads of ``RIPTIDE_*`` names anywhere else in the
+package are rejected by the riplint env-flag analyzer (RIP003, see
+``riptide_tpu/analysis/env_flags.py``), which also fails when a
+registry entry goes stale (no remaining read anywhere in the repo) or
+when ``docs/env_flags.md`` drifts from :func:`render_markdown`.
+
+This module must stay importable WITHOUT jax (and without triggering
+``riptide_tpu/__init__``): the lint runner loads it by file path.
+"""
+import os
+from dataclasses import dataclass, field
+
+__all__ = ["EnvFlag", "FLAGS", "get", "render_markdown"]
+
+# Raw values parsed as False for "bool" flags; anything else set and
+# non-empty is True. An empty string counts as unset (the default
+# applies), matching the package's historical `os.environ.get(...)`
+# truthiness checks.
+_FALSE_WORDS = ("0", "false", "off", "no")
+
+
+@dataclass(frozen=True)
+class EnvFlag:
+    """One registered environment flag.
+
+    type is one of ``bool`` / ``int`` / ``float`` / ``str`` /
+    ``choice`` (``choices`` + optional raw-value ``aliases`` apply to
+    ``choice`` only). ``scope`` is ``package`` for flags read through
+    this registry inside ``riptide_tpu/``, ``tools`` for flags read
+    directly by out-of-package entry points (bench.py, tests/conftest,
+    Makefile) that must stay importable before jax configuration.
+    """
+
+    name: str
+    type: str
+    default: object
+    help: str
+    since: str
+    choices: tuple = ()
+    aliases: dict = field(default_factory=dict)
+    scope: str = "package"
+
+
+_ALL = [
+    EnvFlag(
+        "RIPTIDE_FFA_PATH", "choice", "auto",
+        "Periodogram execution path: `kernel` (fused Pallas cycle "
+        "kernel), `gather` (XLA modular-gather formulation), or `auto` "
+        "(kernel on TPU backends, gather elsewhere).",
+        since="seed", choices=("auto", "kernel", "gather"),
+    ),
+    EnvFlag(
+        "RIPTIDE_WIRE_DTYPE", "choice", None,
+        "Host->device wire transport for downsampled stage data. "
+        "Default: `uint6` on the kernel path, `float32` on the gather "
+        "path.",
+        since="seed",
+        choices=("float32", "float16", "uint12", "uint8", "uint6"),
+        aliases={"u12": "uint12", "u8": "uint8", "u6": "uint6"},
+    ),
+    EnvFlag(
+        "RIPTIDE_KERNEL_BASE3", "bool", True,
+        "Allow base-3 (1.5 * 2^k) kernel containers where the bucket "
+        "fits, cutting power-of-two padding waste ~25% on affected "
+        "stages; `0` forces pure 2^L containers.",
+        since="PR 0 (0.3.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_KERNEL_LANE_SPLIT", "bool", True,
+        "Split each stage's bins trials into lane-occupancy buckets "
+        "(grouped by ceil(p / 128) tiles) so most trials run in a "
+        "narrower container; `0` reverts to one full-width bucket. "
+        "Results are bit-identical either way.",
+        since="PR 4 (0.6.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_KERNEL_RESIDENT", "bool", True,
+        "Keep each bins-trial's all-levels table set resident in a "
+        "persistent VMEM scratch (one DMA per trial instead of one per "
+        "level); `0` forces level-by-level streaming everywhere.",
+        since="seed",
+    ),
+    EnvFlag(
+        "RIPTIDE_KERNEL_CACHE", "str", None,
+        "Directory for the cross-process compiled Pallas-kernel "
+        "executable cache (default `<cache_root>/kernel`); `off` "
+        "disables the cache (kernels compile per process).",
+        since="PR 1 (0.4.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_EXEC_CACHE", "str", None,
+        "Directory for the cross-process cached_jit executable cache "
+        "(default `<cache_root>/exec`); `off` disables it.",
+        since="seed",
+    ),
+    EnvFlag(
+        "RIPTIDE_EXEC_CACHE_MAX_BYTES", "int", 2 << 30,
+        "Byte cap per on-disk executable cache directory: inserts "
+        "evict least-recently-used entries above it; <= 0 disables "
+        "eviction.",
+        since="PR 1 (0.4.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_CACHE_ROOT", "str", None,
+        "Root directory for all on-disk executable caches (explicit "
+        "operator intent, used as given). Default: a trusted "
+        "`.riptide_cache/` at the checkout root, else a per-user 0700 "
+        "tempdir.",
+        since="PR 1 (0.4.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_FAULT_INJECT", "str", None,
+        "Fault-injection spec for the survey scheduler / batch "
+        "searcher, e.g. `stall:0:0.1,raise:2x2,oom:0` (see "
+        "riptide_tpu/survey/faults.py for the grammar). CLI "
+        "`--fault-inject` takes precedence.",
+        since="PR 1 (0.4.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_NATIVE_SANITIZE", "bool", False,
+        "Build the native host library with ASan+UBSan "
+        "(`-fsanitize=address,undefined`, no-recover). The sanitized "
+        ".so only loads when the sanitizer runtimes are preloaded — "
+        "use `make native-asan` / `make sanitize`, which set "
+        "LD_PRELOAD accordingly.",
+        since="PR 5 (0.7.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_BENCH_BUDGET", "float", 1380.0,
+        "Total process wall-time budget (seconds) bench.py runs "
+        "against: the first timed pass always emits a JSON line, "
+        "further best-of-N passes run only while budget remains.",
+        since="PR 1 (0.4.0)", scope="tools",
+    ),
+    EnvFlag(
+        "RIPTIDE_BENCH_DEBUG", "bool", False,
+        "Enable bench.py's periodic faulthandler stack dumps (locates "
+        "long compiles / stalls). Read raw by bench.py: ANY non-empty "
+        "value — including `0` — enables; unset/empty disables.",
+        since="PR 4 (0.6.0)", scope="tools",
+    ),
+    EnvFlag(
+        "RIPTIDE_TESTS_TPU", "bool", False,
+        "Run the test suite against the real TPU backend (`make "
+        "tests-tpu`): tpu-marked tests run, the CPU-backend forcing in "
+        "tests/conftest.py is skipped. Read raw by tests/conftest.py: "
+        "exactly `1` enables; everything else disables.",
+        since="seed", scope="tools",
+    ),
+]
+
+FLAGS = {f.name: f for f in _ALL}
+
+
+def _parse(flag, raw):
+    if flag.type == "bool":
+        return raw.strip().lower() not in _FALSE_WORDS
+    if flag.type == "int":
+        return int(raw)
+    if flag.type == "float":
+        return float(raw)
+    if flag.type == "choice":
+        val = flag.aliases.get(raw, raw)
+        if flag.choices and val not in flag.choices:
+            raise ValueError(
+                f"unsupported {flag.name}={raw!r}: expected one of "
+                f"{flag.choices}"
+            )
+        return val
+    return raw
+
+
+def get(name, env=None):
+    """The parsed value of registered flag ``name``, read from the
+    environment at call time (monkeypatched environments apply).
+    Unset or empty -> the registered default. Raises KeyError for an
+    unregistered name and ValueError for an unparsable value."""
+    flag = FLAGS[name]
+    raw = (os.environ if env is None else env).get(name)
+    if raw is None or raw == "":
+        return flag.default
+    return _parse(flag, raw)
+
+
+def render_markdown():
+    """The full ``docs/env_flags.md`` content, generated from the
+    registry so the documentation cannot drift from the code (riplint
+    RIP003 fails when the checked-in file differs)."""
+    lines = [
+        "# Environment flags",
+        "",
+        "Every `RIPTIDE_*` environment variable the project reads, "
+        "generated",
+        "from the typed registry in `riptide_tpu/utils/envflags.py` "
+        "(regenerate",
+        "with `python tools/riplint.py --write-env-docs`). Package "
+        "code reads",
+        "flags exclusively through `envflags.get(...)`; the riplint "
+        "env-flag",
+        "analyzer (RIP003) rejects direct `os.environ` reads of "
+        "`RIPTIDE_*`",
+        "names and flags stale registry entries.",
+        "",
+        "Registry-routed boolean flags parse `0` / `false` / `off` / "
+        "`no` as",
+        "False and any other non-empty value as True; an empty string "
+        "counts as",
+        "unset (default applies). `scope: tools` flags are read RAW by "
+        "their",
+        "out-of-package entry points (bench.py, tests/conftest.py, "
+        "Makefile)",
+        "before jax configuration — they do NOT follow the registry "
+        "parse; each",
+        "entry below states its exact trigger.",
+        "",
+        "| Flag | Type | Default | Since | Scope |",
+        "|------|------|---------|-------|-------|",
+    ]
+    for f in _ALL:
+        typ = f.type
+        if f.type == "choice":
+            typ = " \\| ".join(f"`{c}`" for c in f.choices)
+            if f.aliases:
+                typ += " (aliases: " + ", ".join(
+                    f"`{a}`" for a in f.aliases) + ")"
+        default = "unset" if f.default is None else f"`{f.default}`"
+        lines.append(
+            f"| `{f.name}` | {typ} | {default} | {f.since} | {f.scope} |"
+        )
+    lines.append("")
+    for f in _ALL:
+        lines.append(f"## `{f.name}`")
+        lines.append("")
+        lines.append(f.help)
+        lines.append("")
+    return "\n".join(lines)

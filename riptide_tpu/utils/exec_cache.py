@@ -34,6 +34,8 @@ import time
 
 import jax
 
+from . import envflags
+
 log = logging.getLogger("riptide_tpu.exec_cache")
 
 __all__ = ["cached_jit", "load_or_compile_exec", "cache_root"]
@@ -103,7 +105,7 @@ def cache_root(checkout_dir=None):
     :func:`_dir_trusted` (ours, not group/other-writable, parent not
     world-writable); a spoofed or over-permissioned directory falls
     back to the tempdir instead of being loaded from."""
-    env = os.environ.get("RIPTIDE_CACHE_ROOT")
+    env = envflags.get("RIPTIDE_CACHE_ROOT")
     if env:
         return env
     repo = checkout_dir or os.path.dirname(
@@ -129,9 +131,8 @@ def cache_root(checkout_dir=None):
     return _user_tmp_cache()
 
 
-_DIR = os.environ.get(
-    "RIPTIDE_EXEC_CACHE", os.path.join(cache_root(), "exec")
-)
+_DIR = (envflags.get("RIPTIDE_EXEC_CACHE")
+        or os.path.join(cache_root(), "exec"))
 
 _lock = threading.Lock()
 _src_hash_memo = None
@@ -157,7 +158,7 @@ _lru_lock = threading.Lock()
 def _cache_cap_bytes():
     """Byte cap per cache directory (default 2 GiB); <= 0 disables
     eviction."""
-    return int(os.environ.get("RIPTIDE_EXEC_CACHE_MAX_BYTES", 2 << 30))
+    return envflags.get("RIPTIDE_EXEC_CACHE_MAX_BYTES")
 
 
 def _manifest_scan(d):
@@ -348,7 +349,7 @@ class _Cached:
         return functools.partial(self.__call__, obj)
 
     def __call__(self, *args, **kw):
-        if not _on_tpu() or os.environ.get("RIPTIDE_EXEC_CACHE") == "off":
+        if not _on_tpu() or envflags.get("RIPTIDE_EXEC_CACHE") == "off":
             return self.jitted(*args, **kw)
         flat = list(args) + [kw[k] for k in sorted(kw)]
         key = self._key(flat)

@@ -1,0 +1,22 @@
+"""BAD fixture for RIP001: host syncs inside a jit body and a hot
+queueing path. Never imported — parsed by the analyzer only."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("n",))
+def traced(x, n):
+    y = x.sum().item()            # sync inside a jit body
+    z = np.asarray(x)             # numpy pull inside a jit body
+    return float(x[0]) + y + z[0]  # float() on a traced value
+
+
+def _queue_stages(plan, parts):
+    out = []
+    for p in parts:
+        p.block_until_ready()     # sync on the enqueue path
+        out.append(np.asarray(p))  # device->host pull on the enqueue path
+    return out

@@ -1,0 +1,22 @@
+"""GOOD fixture for RIP001: the same shapes of code with the syncs
+kept out of the traced/queueing regions."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("n",))
+def traced(x, n):
+    return jnp.sum(x) + jnp.float32(n)
+
+
+def _queue_stages(plan, parts):
+    return [jnp.asarray(p) for p in parts]  # host->device ship is fine
+
+
+def collect(handles):
+    # Pulls belong on the collect side — this function is not listed as
+    # a queueing hot path.
+    return [np.asarray(h) for h in handles]

@@ -1,0 +1,11 @@
+"""BAD fixture for RIP002: implicit dtypes in the numeric core."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def prefix(data, pad):
+    cs = np.cumsum(data)                 # accumulator dtype unstated
+    buf = np.zeros(pad)                  # silent float64
+    idx = jnp.arange(16)                 # index dtype unstated
+    w = jnp.asarray([1.0, 2.0])          # weak-type literal
+    return cs, buf, idx, w

@@ -1,0 +1,12 @@
+"""GOOD fixture for RIP002: every dtype named at the call site."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def prefix(data, pad):
+    cs = np.cumsum(data, dtype=np.float64)
+    buf = np.zeros(pad, np.float32)
+    idx = jnp.arange(16, dtype=jnp.int32)
+    w = jnp.asarray([1.0, 2.0], dtype=jnp.float32)
+    arr = np.asarray(data, dtype=np.float32)  # named array: fine
+    return cs, buf, idx, w, arr

@@ -1,0 +1,13 @@
+"""BAD fixture for RIP003: raw RIPTIDE_* environment reads and an
+unregistered flag."""
+import os
+
+from riptide_tpu.utils import envflags
+
+
+def raw_reads():
+    a = os.environ.get("RIPTIDE_BOGUS_FLAG")        # raw read
+    b = os.getenv("RIPTIDE_FAULT_INJECT")           # raw read
+    c = os.environ["RIPTIDE_CACHE_ROOT"]            # raw subscript
+    d = envflags.get("RIPTIDE_NOT_REGISTERED")      # unknown flag
+    return a, b, c, d

@@ -1,0 +1,12 @@
+"""GOOD fixture for RIP003: flags read through the typed registry
+(and non-RIPTIDE environment reads stay unrestricted)."""
+import os
+
+from riptide_tpu.utils import envflags
+
+
+def registry_reads():
+    path = envflags.get("RIPTIDE_FFA_PATH")
+    budget = envflags.get("RIPTIDE_EXEC_CACHE_MAX_BYTES")
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")  # not a RIPTIDE_ flag
+    return path, budget, coord

@@ -1,0 +1,24 @@
+"""BAD fixture for RIP004: blocking under a lock, untimed join/wait,
+implicit daemon flag."""
+import subprocess
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def build_under_lock(cmd):
+    with _lock:
+        subprocess.run(cmd, check=True)   # subprocess while holding a lock
+        time.sleep(1.0)                   # sleep while holding a lock
+
+
+def shutdown(worker, done):
+    worker.join()                         # untimed join
+    done.wait()                           # untimed wait
+
+
+def spawn(fn):
+    t = threading.Thread(target=fn)       # daemon flag unstated
+    t.start()
+    return t

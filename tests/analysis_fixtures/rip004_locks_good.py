@@ -1,0 +1,31 @@
+"""GOOD fixture for RIP004: bounded waits, explicit daemon flags,
+blocking work outside the critical section."""
+import subprocess
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def build_outside_lock(cmd):
+    with _lock:
+        stale = True
+    if stale:
+        subprocess.run(cmd, check=True)
+
+
+def shutdown(worker, done):
+    worker.join(timeout=5.0)
+    if worker.is_alive():
+        raise TimeoutError("worker wedged")
+    done.wait(5.0)
+
+
+def spawn(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+def pace():
+    time.sleep(0.01)  # sleeping outside a lock is fine

@@ -1,0 +1,28 @@
+"""BAD fixture for RIP005: implicit memory space, missing out_shape,
+dynamic grid, nondeterminism inside a kernel closure."""
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _noise():
+    return time.time()
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * _noise()    # host nondeterminism captured
+
+
+def run(x, n):
+    call = pl.pallas_call(                 # no out_shape
+        _kernel,
+        grid=(compute_grid(n),),           # dynamic grid expression
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],  # no memory_space
+    )
+    return call(x)
+
+
+def compute_grid(n):
+    return n // 8

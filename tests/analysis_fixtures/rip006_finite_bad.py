@@ -1,0 +1,16 @@
+"""BAD fixture for RIP006: a checked entry point that skips the
+data-quality layer."""
+from .. import quality
+
+
+def _scan(x):
+    return quality.check_finite_array(x)
+
+
+def boxcar_snr(x, widths):
+    return x.sum() + len(widths)   # unguarded: no quality routing
+
+
+def snr_batched(x, widths):
+    _scan(x)                       # guarded via a local helper
+    return x.sum()

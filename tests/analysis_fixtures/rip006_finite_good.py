@@ -1,0 +1,17 @@
+"""GOOD fixture for RIP006: every checked entry point routes through
+riptide_tpu.quality (directly or via one local helper)."""
+from .. import quality
+
+
+def _scan(x):
+    return quality.check_finite_array(x)
+
+
+def boxcar_snr(x, widths):
+    quality.check_finite_array(x)
+    return x.sum() + len(widths)
+
+
+def snr_batched(x, widths):
+    _scan(x)
+    return x.sum()

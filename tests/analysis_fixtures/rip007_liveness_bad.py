@@ -1,0 +1,14 @@
+"""BAD fixture for RIP007: a raw multihost collective outside the
+allowed wrappers, plus an alias import that would evade the call
+check."""
+from jax.experimental import multihost_utils
+from jax.experimental import multihost_utils as mhu
+
+
+def gather(x):
+    return multihost_utils.process_allgather(x)   # raw collective
+
+
+def ok(x):
+    # The allowed wrapper (tests allowlist this function name).
+    return multihost_utils.process_allgather(x)

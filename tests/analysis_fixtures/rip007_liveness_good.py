@@ -1,0 +1,13 @@
+"""GOOD fixture for RIP007: collectives only inside the allowed
+bounded-wait wrappers."""
+from jax.experimental import multihost_utils
+
+
+def ok(x):
+    # The allowed wrapper (tests allowlist this function name); its
+    # presence also satisfies the vacuous-lint guard.
+    return multihost_utils.process_allgather(x)
+
+
+def caller(x):
+    return ok(x)

@@ -1,0 +1,347 @@
+"""
+Tier-1 enforcement of the riplint static-analysis framework
+(tools/riplint.py + riptide_tpu/analysis/):
+
+* the repo itself is clean against the checked-in baseline (this is
+  the tier-1 wiring of every analyzer, including the ported finite- and
+  liveness-guard rules);
+* each of the 7 analyzers fails on its bad fixture and passes on its
+  good fixture (tests/analysis_fixtures/ — guard against vacuous
+  lints);
+* the runner's exit codes, baseline absorption, stale-entry detection
+  and inline-pragma suppression behave as documented;
+* the analyzer set and rule ids are stable (a rename or renumber is an
+  API break for baselines and pragmas — this must be a deliberate,
+  test-acknowledged change);
+* docs/env_flags.md matches the envflags registry and every RIPTIDE_*
+  token in package sources is a registered flag.
+"""
+import io
+import importlib.util
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURES = os.path.join(HERE, "analysis_fixtures")
+RIPLINT = os.path.join(REPO, "tools", "riplint.py")
+
+
+def _load_riplint():
+    spec = importlib.util.spec_from_file_location("riplint_under_test",
+                                                  RIPLINT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+riplint = _load_riplint()
+analysis = riplint.load_analysis(REPO)
+
+
+def _mini_repo(tmp_path, mapping):
+    """Build a throwaway repo: copy fixtures to their package-relative
+    destinations, plus the real envflags.py (the RIP003 registry)."""
+    for dest_rel, fixture in mapping.items():
+        dest = tmp_path / dest_rel
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(FIXTURES, fixture), dest)
+    reg = tmp_path / "riptide_tpu" / "utils" / "envflags.py"
+    reg.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy(os.path.join(REPO, "riptide_tpu", "utils", "envflags.py"),
+                reg)
+    return str(tmp_path)
+
+
+def _run_one(repo, analyzer, dest_rel):
+    ctx = analysis.ModuleContext(repo, dest_rel)
+    return analyzer.run(ctx)
+
+
+# -- per-analyzer fixture pairs ---------------------------------------------
+
+# (analyzer factory, destination relpath, bad fixture, good fixture,
+#  minimum bad findings)
+CASES = [
+    (analysis.HostSyncAnalyzer, "riptide_tpu/search/engine.py",
+     "rip001_host_sync_bad.py", "rip001_host_sync_good.py", 5),
+    (analysis.DtypeDisciplineAnalyzer, "riptide_tpu/ops/fixture.py",
+     "rip002_dtype_bad.py", "rip002_dtype_good.py", 4),
+    (analysis.EnvFlagAnalyzer, "riptide_tpu/pipeline/fixture.py",
+     "rip003_envflags_bad.py", "rip003_envflags_good.py", 4),
+    (analysis.LockDisciplineAnalyzer, "riptide_tpu/survey/liveness.py",
+     "rip004_locks_bad.py", "rip004_locks_good.py", 5),
+    (analysis.PallasLayoutAnalyzer, "riptide_tpu/ops/kern.py",
+     "rip005_pallas_bad.py", "rip005_pallas_good.py", 4),
+    (lambda: analysis.FiniteGuardAnalyzer(
+        entry_points={"riptide_tpu/ops/snr.py": ["boxcar_snr",
+                                                 "snr_batched"]}),
+     "riptide_tpu/ops/snr.py",
+     "rip006_finite_bad.py", "rip006_finite_good.py", 1),
+    (lambda: analysis.LivenessGuardAnalyzer(
+        allowed={"riptide_tpu/parallel/mh.py": {"ok"}}),
+     "riptide_tpu/parallel/mh.py",
+     "rip007_liveness_bad.py", "rip007_liveness_good.py", 2),
+]
+
+
+@pytest.mark.parametrize(
+    "factory,dest,bad,good,min_bad", CASES,
+    ids=[c[2].rsplit("_", 1)[0] for c in CASES],
+)
+def test_analyzer_fails_bad_and_passes_good(tmp_path, factory, dest, bad,
+                                            good, min_bad):
+    repo_bad = _mini_repo(tmp_path / "bad", {dest: bad})
+    inst = factory()
+    findings = _run_one(repo_bad, inst, dest)
+    assert len(findings) >= min_bad, \
+        f"expected >= {min_bad} findings on {bad}, got " \
+        f"{[f.gh() for f in findings]}"
+    assert all(f.rule == inst.rule for f in findings)
+    assert all(f.path == dest and f.line >= 1 for f in findings)
+
+    repo_good = _mini_repo(tmp_path / "good", {dest: good})
+    inst2 = factory()
+    findings = _run_one(repo_good, inst2, dest)
+    assert findings == [], "\n".join(f.gh() for f in findings)
+
+
+def test_liveness_good_fixture_not_vacuous(tmp_path):
+    """The good RIP007 fixture must keep the wrapped-call counter
+    non-zero, or finalize would report the lint as vacuous."""
+    dest = "riptide_tpu/parallel/mh.py"
+    repo = _mini_repo(tmp_path, {dest: "rip007_liveness_good.py"})
+    inst = analysis.LivenessGuardAnalyzer(allowed={dest: {"ok"}})
+    assert _run_one(repo, inst, dest) == []
+    assert inst.finalize(repo, []) == []
+
+
+# -- whole-repo cleanliness (the tier-1 wiring) -----------------------------
+
+def test_repo_is_clean_against_baseline():
+    out, err = io.StringIO(), io.StringIO()
+    code = riplint.run(out=out, err=err)
+    assert code == 0, f"riplint found new issues:\n{out.getvalue()}"
+
+
+def test_runner_exit_codes_subprocess():
+    proc = subprocess.run([sys.executable, RIPLINT], capture_output=True,
+                          text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "riplint OK" in proc.stderr
+
+
+def test_runner_flags_violation_and_baseline_absorbs(tmp_path):
+    dest = "riptide_tpu/survey/liveness.py"
+    repo = _mini_repo(tmp_path, {dest: "rip004_locks_bad.py"})
+    analyzers = [analysis.LockDisciplineAnalyzer(modules={dest})]
+
+    new, baselined, stale = analysis.run_analyzers(
+        repo, analyzers, baseline=analysis.Baseline()
+    )
+    assert new and not baselined and not stale
+    # GitHub-annotation format: path:line:col: RIPxxx message
+    assert re.match(r"^riptide_tpu/survey/liveness\.py:\d+:\d+: RIP004 ",
+                    new[0].gh())
+
+    # A baseline entry matching each finding's (rule, path, line text)
+    # absorbs them all...
+    ctx = analysis.ModuleContext(repo, dest)
+    entries = [analysis.Baseline.entry_for(f, ctx, why="fixture")
+               for f in new]
+    new2, baselined2, stale2 = analysis.run_analyzers(
+        repo, analyzers, baseline=analysis.Baseline(entries)
+    )
+    assert new2 == [] and len(baselined2) >= len(entries) - 1
+    assert stale2 == []
+
+    # ... and an entry matching nothing is reported stale.
+    bogus = [{"rule": "RIP004", "path": dest,
+              "line_text": "this_line_does_not_exist()",
+              "why": "stale"}]
+    _, _, stale3 = analysis.run_analyzers(
+        repo, analyzers, baseline=analysis.Baseline(entries + bogus)
+    )
+    assert stale3 == bogus
+
+
+def test_scope_lists_fail_loudly_when_stale(tmp_path):
+    """RIP001/RIP002/RIP004 scope their checks by module/function name;
+    a rename must produce a stale-scope finding, not silently unscope
+    the lint (review regression)."""
+    repo = str(tmp_path)
+    mod = tmp_path / "riptide_tpu" / "search" / "engine.py"
+    mod.parent.mkdir(parents=True)
+    # engine.py exists but the hot function was "renamed" away.
+    mod.write_text("def renamed_queue_stages():\n    pass\n")
+
+    new, _, _ = analysis.run_analyzers(
+        repo,
+        [analysis.HostSyncAnalyzer, analysis.LockDisciplineAnalyzer,
+         analysis.DtypeDisciplineAnalyzer],
+        baseline=analysis.Baseline(),
+    )
+    msgs = [f.gh() for f in new]
+    assert any("_queue_stages" in m and "stale" in m for m in msgs), msgs
+    # Every configured-but-missing module is reported by each analyzer.
+    assert any("batcher.py" in m and "stale" in m for m in msgs), msgs
+    assert any("liveness.py" in m and "stale" in m for m in msgs), msgs
+    assert any("peaks_device.py" in m and "stale" in m for m in msgs), msgs
+
+
+def test_untimed_join_under_lock_reported_once(tmp_path):
+    """One defect, one finding: the under-lock and module-wide walks
+    must not double-report the same untimed join (review regression)."""
+    dest = "riptide_tpu/survey/liveness.py"
+    repo = str(tmp_path)
+    mod = tmp_path / dest
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def drain(worker):\n"
+        "    with _lock:\n"
+        "        worker.join()\n"
+    )
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.LockDisciplineAnalyzer(modules={dest})],
+        baseline=analysis.Baseline(),
+    )
+    joins = [f for f in new if "join" in f.message]
+    assert len(joins) == 1, [f.gh() for f in new]
+
+
+def test_pathonly_baseline_entry_is_not_stale(tmp_path):
+    """An empty-line_text entry is the documented way to baseline a
+    finding outside the package (no ModuleContext, e.g. docs drift);
+    it must absorb the finding AND count as used, or the run could
+    never go green."""
+    repo = str(tmp_path)
+    (tmp_path / "riptide_tpu").mkdir()
+    (tmp_path / "riptide_tpu" / "empty.py").write_text("x = 1\n")
+
+    class OutsideFinding(analysis.Analyzer):
+        rule = "RIP999"
+        name = "outside"
+
+        def finalize(self, repo, contexts):
+            return [analysis.Finding("docs/somewhere.md", 1, 0,
+                                     self.rule, "drifted")]
+
+    entry = {"rule": "RIP999", "path": "docs/somewhere.md",
+             "line_text": "", "why": "tracked elsewhere"}
+    new, baselined, stale = analysis.run_analyzers(
+        repo, [OutsideFinding], baseline=analysis.Baseline([entry])
+    )
+    assert new == [] and len(baselined) == 1 and stale == []
+
+
+def test_reused_analyzer_instance_resets_state(tmp_path):
+    """A reused instance must not leak run state: after a clean run
+    over a tree WITH wrapped collectives, a second run over a tree
+    WITHOUT them must still report the vacuous-lint failure."""
+    dest = "riptide_tpu/parallel/mh.py"
+    good = _mini_repo(tmp_path / "a", {dest: "rip007_liveness_good.py"})
+    empty = str(tmp_path / "b")
+    (tmp_path / "b" / "riptide_tpu").mkdir(parents=True)
+    (tmp_path / "b" / "riptide_tpu" / "empty.py").write_text("x = 1\n")
+
+    inst = analysis.LivenessGuardAnalyzer(allowed={dest: {"ok"}})
+    new1, _, _ = analysis.run_analyzers(good, [inst],
+                                        baseline=analysis.Baseline())
+    assert new1 == []
+    new2, _, _ = analysis.run_analyzers(empty, [inst],
+                                        baseline=analysis.Baseline())
+    assert len(new2) == 1 and "vacuous" in new2[0].message
+
+
+def test_keyword_timeout_under_lock_not_flagged(tmp_path):
+    """A wait/join with a keyword timeout under a held lock follows
+    the rule and must not be flagged (review regression)."""
+    dest = "riptide_tpu/survey/liveness.py"
+    repo = str(tmp_path)
+    mod = tmp_path / dest
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def drain(evt, worker):\n"
+        "    with _lock:\n"
+        "        evt.wait(timeout=5.0)\n"
+        "        worker.join(timeout=5.0)\n"
+    )
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.LockDisciplineAnalyzer(modules={dest})],
+        baseline=analysis.Baseline(),
+    )
+    assert new == [], "\n".join(f.gh() for f in new)
+
+
+def test_inline_pragma_suppression(tmp_path):
+    dest = "riptide_tpu/survey/liveness.py"
+    repo = str(tmp_path)
+    mod = tmp_path / dest
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "def shutdown(done, worker):\n"
+        "    done.wait()  # riplint: disable=RIP004\n"
+        "    worker.join()\n"
+    )
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.LockDisciplineAnalyzer(modules={dest})],
+        baseline=analysis.Baseline(),
+    )
+    # Only the unsuppressed join() survives.
+    assert len(new) == 1 and "join" in new[0].message
+
+
+# -- stability + docs -------------------------------------------------------
+
+def test_analyzer_set_and_rule_ids_are_stable():
+    """Rule ids are an API: baselines, pragmas and CI annotations key
+    on them. Renaming or renumbering must be a deliberate change that
+    updates this test (and migrates the baseline)."""
+    got = {(a.rule, a.name) for a in analysis.ALL_ANALYZERS}
+    assert got == {
+        ("RIP001", "host-sync"),
+        ("RIP002", "dtype-discipline"),
+        ("RIP003", "env-flags"),
+        ("RIP004", "lock-discipline"),
+        ("RIP005", "pallas-layout"),
+        ("RIP006", "finite-guards"),
+        ("RIP007", "liveness-guards"),
+    }
+    rules = [a.rule for a in analysis.ALL_ANALYZERS]
+    assert len(rules) == len(set(rules)) == 7
+
+
+def test_env_docs_in_sync_with_registry():
+    registry = analysis.env_flags.load_registry(REPO)
+    with open(os.path.join(REPO, "docs", "env_flags.md")) as fobj:
+        assert fobj.read() == registry.render_markdown()
+
+
+def test_every_package_flag_token_is_registered():
+    registry = analysis.env_flags.load_registry(REPO)
+    token = re.compile(r"RIPTIDE_[A-Z0-9_]+")
+    unknown = set()
+    for ctx in analysis.collect_contexts(REPO):
+        unknown.update(t for t in token.findall(ctx.source)
+                       if t not in registry.FLAGS)
+    assert unknown == set(), \
+        f"undeclared RIPTIDE_* names in package sources: {sorted(unknown)}"
+
+
+def test_baseline_entries_are_justified():
+    with open(os.path.join(REPO, "tools", "riplint_baseline.json")) as fobj:
+        entries = json.load(fobj)["entries"]
+    assert entries, "baseline exists and is non-empty"
+    for e in entries:
+        assert e["why"] and "TODO" not in e["why"], \
+            f"unjustified baseline entry: {e}"

@@ -1,123 +1,38 @@
 #!/usr/bin/env python
 """
-Static lint: every public data entry point routes through the
-data-quality layer (riptide_tpu.quality).
-
-A single NaN reaching the compute path silently poisons a whole
-periodogram, so the guard discipline is structural, not optional: each
-checked function must — directly, or through one local helper it
-calls — invoke something from ``riptide_tpu.quality`` (a ``quality.*``
-attribute call, or a name imported from the quality module). The check
-is AST-based and runs in tier-1 via ``tests/test_finite_guards.py``, so
-a future kernel or reader cannot silently drop the guard.
-
-Checked entry points:
-
-* ``riptide_tpu/ops/snr.py``: every function in ``__all__``;
-* ``riptide_tpu/time_series.py``: the TimeSeries constructors and
-  ``normalise``.
+Back-compat shim: the finite-guard lint now lives in the riplint
+framework (``riptide_tpu/analysis/finite_guards.py``, rule RIP006, run
+by ``tools/riplint.py`` / ``make check``). This entry point keeps the
+historical CLI and the ``check()`` / ``check_module()`` API working
+for existing invocations and tests.
 
 Exit status 0 when clean; 1 with one violation per line otherwise.
 """
-import ast
+import importlib.util
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# path (repo-relative) -> list of required-guarded function/method names
-ENTRY_POINTS = {
-    os.path.join("riptide_tpu", "ops", "snr.py"): [
-        "boxcar_snr", "snr_batched",
-    ],
-    os.path.join("riptide_tpu", "time_series.py"): [
-        "from_binary", "from_npy_file", "from_presto_inf", "from_sigproc",
-        "from_numpy_array", "generate", "normalise",
-    ],
-}
+
+def _analysis():
+    spec = importlib.util.spec_from_file_location(
+        "riplint_shim", os.path.join(REPO, "tools", "riplint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.load_analysis(REPO)
 
 
-def _quality_aliases(tree):
-    """Names bound (anywhere in the module, including inside function
-    bodies) by ``from ...quality import X [as Y]``."""
-    aliases = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module \
-                and node.module.split(".")[-1] == "quality":
-            for a in node.names:
-                aliases.add(a.asname or a.name)
-    return aliases
+_fg = _analysis().finite_guards
 
-
-def _called_names(fn_node):
-    """Names invoked inside a function body: bare calls by name,
-    attribute calls by attribute name (covers self.x / cls.x /
-    quality.x)."""
-    direct_quality = False
-    names = set()
-    for node in ast.walk(fn_node):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if isinstance(f, ast.Name):
-            names.add(f.id)
-        elif isinstance(f, ast.Attribute):
-            names.add(f.attr)
-            if isinstance(f.value, ast.Name) and f.value.id == "quality":
-                direct_quality = True
-    return names, direct_quality
-
-
-def _functions(tree):
-    """{name: node} over every (async) function/method in the module.
-    Later definitions win, matching runtime shadowing."""
-    out = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            out[node.name] = node
-    return out
-
-
-def check_module(path, required):
-    """Violation strings for one module (empty list = clean)."""
-    with open(path) as fobj:
-        tree = ast.parse(fobj.read(), filename=path)
-    aliases = _quality_aliases(tree)
-    functions = _functions(tree)
-
-    def guarded_directly(name):
-        node = functions.get(name)
-        if node is None:
-            return False
-        called, direct = _called_names(node)
-        return direct or bool(called & aliases)
-
-    violations = []
-    for name in required:
-        node = functions.get(name)
-        if node is None:
-            violations.append(f"{path}: entry point {name!r} not found "
-                              "(update tools/check_finite_guards.py)")
-            continue
-        if guarded_directly(name):
-            continue
-        # One level of indirection: a local helper that is itself guarded.
-        called, _ = _called_names(node)
-        if any(guarded_directly(h) for h in called if h in functions):
-            continue
-        violations.append(
-            f"{path}:{node.lineno}: {name!r} does not route through the "
-            "data-quality layer (riptide_tpu.quality)"
-        )
-    return violations
+ENTRY_POINTS = _fg.ENTRY_POINTS
+check_module = _fg.check_module
 
 
 def check(repo=REPO):
     """All violations across the configured entry points."""
-    violations = []
-    for rel, required in ENTRY_POINTS.items():
-        violations.extend(check_module(os.path.join(repo, rel), required))
-    return violations
+    return _fg.check(repo)
 
 
 def main():

@@ -1,144 +1,39 @@
 #!/usr/bin/env python
 """
-Static lint: every ``multihost_utils`` collective call site in
-``riptide_tpu/`` goes through the liveness layer's bounded-wait
-wrappers.
-
-A raw ``multihost_utils.process_allgather`` (or any other collective)
-blocks forever when a peer is dead — exactly the failure mode the
-liveness layer exists to bound — so the discipline is structural: the
-ONLY functions allowed to invoke an attribute of ``multihost_utils``
-are the wrappers in ``riptide_tpu/survey/liveness.py``
-(``bounded_allgather``, ``barrier_with_timeout``), which run the
-collective under :func:`bounded_wait`. Everything else must call those
-wrappers. The check is AST-based and runs in tier-1 via
-``tests/test_liveness_guards.py`` and the Makefile ``check`` target, so
-a future call site cannot silently reintroduce an unbounded wait.
-
-The lint also fails when it finds NO ``multihost_utils`` call at all
-inside the allowed wrappers — that would mean the wrappers were
-refactored away and the lint had gone vacuous.
+Back-compat shim: the bounded-collective lint now lives in the riplint
+framework (``riptide_tpu/analysis/liveness_guards.py``, rule RIP007,
+run by ``tools/riplint.py`` / ``make check``). This entry point keeps
+the historical CLI and the ``check()`` / ``check_file()`` API working
+for existing invocations and tests.
 
 Exit status 0 when clean; 1 with one violation per line otherwise.
 """
-import ast
+import importlib.util
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "riptide_tpu")
-
-# file (repo-relative) -> function names allowed to call multihost_utils
-ALLOWED = {
-    os.path.join("riptide_tpu", "survey", "liveness.py"):
-        {"bounded_allgather", "barrier_with_timeout"},
-}
 
 
-def _is_multihost_attr(node):
-    """True for an attribute access rooted at a name (or attribute)
-    called ``multihost_utils`` — covers ``multihost_utils.x`` and
-    ``jax.experimental.multihost_utils.x``."""
-    if not isinstance(node, ast.Attribute):
-        return False
-    v = node.value
-    if isinstance(v, ast.Name):
-        return v.id == "multihost_utils"
-    if isinstance(v, ast.Attribute):
-        return v.attr == "multihost_utils"
-    return False
+def _analysis():
+    spec = importlib.util.spec_from_file_location(
+        "riplint_shim", os.path.join(REPO, "tools", "riplint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.load_analysis(REPO)
 
 
-def _call_sites(tree):
-    """Sites that can reach a collective, as ``(lineno, enclosing
-    function name or None, kind)``:
+_lg = _analysis().liveness_guards
 
-    * ``call`` — a ``multihost_utils.<collective>(...)`` call;
-    * ``import`` — a binding that would let later calls evade the
-      attribute check: ``from ...multihost_utils import X`` (a
-      collective under a bare name), ``from jax.experimental import
-      multihost_utils as Y`` or ``import ...multihost_utils as Y``
-      (the module under an alias). These are violations at the import
-      itself, wherever the call happens.
-
-    ``from jax.experimental import multihost_utils`` (the module under
-    its own name) is fine — its call sites match the attribute check.
-    """
-    sites = []
-
-    def visit(node, fn):
-        name = fn
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            name = node.name
-        if isinstance(node, ast.Call) and _is_multihost_attr(node.func):
-            sites.append((node.lineno, name, "call"))
-        elif isinstance(node, ast.ImportFrom):
-            if node.module \
-                    and node.module.split(".")[-1] == "multihost_utils":
-                sites.append((node.lineno, name, "import"))
-            else:
-                for a in node.names:
-                    if a.name == "multihost_utils" and a.asname not in (
-                            None, "multihost_utils"):
-                        sites.append((node.lineno, name, "import"))
-        elif isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name.split(".")[-1] == "multihost_utils" \
-                        and a.asname is not None:
-                    sites.append((node.lineno, name, "import"))
-        for child in ast.iter_child_nodes(node):
-            visit(child, name)
-
-    visit(tree, None)
-    return sites
-
-
-def check_file(path, rel, allowed):
-    """Violation strings for one module (empty list = clean); second
-    return value counts call sites inside allowed wrappers."""
-    with open(path) as fobj:
-        tree = ast.parse(fobj.read(), filename=path)
-    violations, wrapped = [], 0
-    for lineno, fn, kind in _call_sites(tree):
-        if fn is not None and fn in allowed.get(rel, ()):
-            if kind == "call":
-                wrapped += 1
-            continue
-        what = ("raw multihost_utils collective" if kind == "call"
-                else "multihost_utils import that evades the call check")
-        violations.append(
-            f"{rel}:{lineno}: {what} "
-            f"{'in ' + fn + '()' if fn else 'at module level'} — route it "
-            "through riptide_tpu.survey.liveness (bounded_allgather / "
-            "barrier_with_timeout) so a dead peer cannot deadlock the run"
-        )
-    return violations, wrapped
+ALLOWED = _lg.ALLOWED
+check_file = _lg.check_file
 
 
 def check(repo=REPO, allowed=None):
     """All violations across ``riptide_tpu/``; vacuous-lint guard
-    included (see module docstring)."""
-    allowed = ALLOWED if allowed is None else allowed
-    package = os.path.join(repo, "riptide_tpu")
-    violations, wrapped_total = [], 0
-    for dirpath, dirnames, filenames in os.walk(package):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, repo)
-            v, wrapped = check_file(path, rel, allowed)
-            violations.extend(v)
-            wrapped_total += wrapped
-    if wrapped_total == 0:
-        violations.append(
-            "no multihost_utils call found inside the allowed liveness "
-            "wrappers — the lint has gone vacuous (were "
-            "bounded_allgather/barrier_with_timeout refactored away? "
-            "update tools/check_liveness_guards.py)"
-        )
-    return violations
+    included (see riptide_tpu/analysis/liveness_guards.py)."""
+    return _lg.check(repo, allowed=allowed)
 
 
 def main():

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""
+riplint: run every riptide_tpu static analyzer over the package.
+
+The analyzers live in ``riptide_tpu/analysis/`` (loaded standalone by
+file path — no jax, no package __init__, so this runs anywhere).
+Output is GitHub-annotation format, one finding per line::
+
+    riptide_tpu/search/engine.py:991:8: RIP001 `np.asarray` inside ...
+
+Exit status 0 when the repo is clean against the checked-in baseline
+(``tools/riplint_baseline.json``); 1 when there are new findings OR
+stale baseline entries (an entry whose code is gone must be deleted —
+a baseline only stays honest if it cannot accumulate dead weight).
+
+Suppression, in reviewability order:
+
+* fix the finding;
+* ``# riplint: disable=RIPxxx`` on the flagged line (visible in the
+  diff it suppresses);
+* a baseline entry with a one-line ``why`` (for intentional,
+  long-lived exceptions: documented sync points, build-serialisation
+  locks). ``--update-baseline`` regenerates the file, keeping the
+  justifications of surviving entries; new entries get a TODO you must
+  edit before committing.
+
+``--write-env-docs`` regenerates ``docs/env_flags.md`` from the
+``utils/envflags.py`` registry (the RIP003 analyzer fails on drift).
+"""
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "riplint_baseline.json")
+
+
+def load_analysis(repo=REPO):
+    """The riptide_tpu.analysis package, loaded standalone so importing
+    it never drags in jax (or riptide_tpu/__init__)."""
+    name = "riptide_tpu_analysis_standalone"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(repo, "riptide_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        del sys.modules[name]
+        raise
+    return mod
+
+
+def run(repo=REPO, baseline_path=DEFAULT_BASELINE, analyzers=None,
+        update_baseline=False, out=sys.stdout, err=sys.stderr):
+    """Run the analyzers; returns the process exit code."""
+    analysis = load_analysis(repo)
+    analyzers = analyzers or analysis.ALL_ANALYZERS
+    baseline = analysis.Baseline.load(baseline_path)
+    contexts = analysis.collect_contexts(repo)
+    new, baselined, stale = analysis.run_analyzers(
+        repo, analyzers, baseline=baseline, contexts=contexts
+    )
+
+    if update_baseline:
+        by_rel = {c.relpath: c for c in contexts}
+        kept = [e for e in baseline.entries if e not in stale]
+        seen = {(e["rule"], e["path"], e["line_text"].strip())
+                for e in kept}
+        added = []
+        for f in new:
+            ctx = by_rel.get(f.path)
+            if ctx is not None:
+                entry = analysis.Baseline.entry_for(f, ctx)
+            else:
+                # Finding outside the package (e.g. docs drift): emit
+                # the path-only (empty line_text) entry form that
+                # Baseline.matches_pathonly absorbs, instead of
+                # silently dropping it and leaving the next plain run
+                # red.
+                entry = {"rule": f.rule, "path": f.path,
+                         "line_text": "", "why": "TODO: justify"}
+            key = (entry["rule"], entry["path"],
+                   entry["line_text"].strip())
+            if key in seen:
+                continue
+            seen.add(key)
+            added.append(entry)
+        analysis.Baseline(kept + added, path=baseline_path).dump()
+        print(
+            f"baseline updated: {len(kept)} kept, {len(added)} added "
+            f"(edit their TODO justifications), {len(stale)} stale "
+            "dropped", file=err,
+        )
+        return 0
+
+    for f in new:
+        print(f.gh(), file=out)
+    for e in stale:
+        print(
+            f"{e['path']}:1:0: {e['rule']} STALE baseline entry "
+            f"(line_text={e['line_text']!r}) — the code it justified is "
+            "gone; delete the entry or run --update-baseline",
+            file=out,
+        )
+    n_rules = len({a.rule for a in
+                   (x() if isinstance(x, type) else x for x in analyzers)})
+    if new or stale:
+        print(
+            f"riplint: {len(new)} new finding(s), {len(stale)} stale "
+            f"baseline entr(y/ies) ({len(baselined)} baselined, "
+            f"{n_rules} analyzers over {len(contexts)} modules)",
+            file=err,
+        )
+        return 1
+    print(
+        f"riplint OK: {n_rules} analyzers over {len(contexts)} modules, "
+        f"0 new findings ({len(baselined)} baselined)", file=err,
+    )
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="riplint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default tools/riplint_baseline"
+                         ".json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to absorb current "
+                         "findings (justifications of surviving entries "
+                         "are kept; new entries get a TODO)")
+    ap.add_argument("--write-env-docs", action="store_true",
+                    help="regenerate docs/env_flags.md from the "
+                         "utils/envflags.py registry and exit")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the analyzer set (rule id, name, "
+                         "description) and exit")
+    args = ap.parse_args(argv)
+
+    analysis = load_analysis()
+    if args.list_rules:
+        for cls in analysis.ALL_ANALYZERS:
+            print(f"{cls.rule}  {cls.name}: {cls.description}")
+        return 0
+    if args.write_env_docs:
+        registry = analysis.env_flags.load_registry(REPO)
+        path = os.path.join(REPO, "docs", "env_flags.md")
+        with open(path, "w") as fobj:
+            fobj.write(registry.render_markdown())
+        print(f"wrote {os.path.relpath(path, REPO)}", file=sys.stderr)
+        return 0
+    return run(baseline_path=args.baseline,
+               update_baseline=args.update_baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
