@@ -3,12 +3,12 @@
 PYTHON ?= python
 
 .PHONY: install check lint native-asan sanitize tests tests-cov native \
-	bench clean
+	bench trace-demo clean
 
 install:
 	$(PYTHON) -m pip install -e .
 
-# Static analysis: the riplint framework (tools/riplint.py — 7 analyzers
+# Static analysis: the riplint framework (tools/riplint.py — 8 analyzers
 # including the ported finite/liveness guards) against the checked-in
 # baseline. Also enforced in tier-1 via tests/test_riplint.py; the old
 # tools/check_*.py entry points remain as shims onto the same analyzers.
@@ -66,6 +66,13 @@ native:
 # Headline benchmark on the default device (ONE JSON line).
 bench:
 	$(PYTHON) bench.py
+
+# Tiny CPU survey with the span tracer on: writes a Perfetto-loadable
+# Chrome trace, a journal with per-chunk timing blocks, and a
+# Prometheus textfile under /tmp/riptide_trace_demo (see
+# docs/observability.md).
+trace-demo:
+	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) tools/trace_demo.py
 
 clean:
 	rm -rf riptide_tpu/native/_build build dist *.egg-info

@@ -203,18 +203,14 @@ def _pipeline_pass(plan, tobs, nchunks, dms, batch_for, prepper, shipper):
 
 def _submetrics(nchunks, elapsed):
     """Machine-readable sub-metrics of the pass just timed, from the
-    metrics registry the engine records into: where the time went
-    (device_s / prep_s), the wire rate that usually bounds it
-    (wire_MBps), and the steady-state per-chunk cost (chunk_s)."""
+    metrics registry the engine records into. The key set is the ONE
+    timing schema (riptide_tpu.obs.schema.decomposition) shared with
+    tools/stime.py's closing block and the survey journal, so every
+    surface a driver log parser reads carries identical names."""
+    from riptide_tpu.obs.schema import decomposition
     from riptide_tpu.survey.metrics import get_metrics
 
-    s = get_metrics().summary()
-    return {
-        "device_s": round(s.get("device_s", 0.0), 3),
-        "prep_s": round(s.get("prep_s", 0.0), 3),
-        "wire_MBps": s.get("wire_MBps"),
-        "chunk_s": round(elapsed / max(nchunks, 1), 3),
-    }
+    return decomposition(get_metrics().summary(), nchunks, elapsed)
 
 
 def bench_headline():

@@ -1,7 +1,7 @@
 """
 riplint: the shared static-analysis framework.
 
-A single AST walk over the package feeds seven analyzers, each owning
+A single AST walk over the package feeds eight analyzers, each owning
 one stable rule id (asserted by tests/test_riplint.py):
 
 ========  ==========================  =====================================
@@ -27,6 +27,11 @@ RIP007    liveness-guards             multihost_utils collectives route
                                       through the bounded-wait wrappers
                                       (ported from
                                       tools/check_liveness_guards.py)
+RIP008    obs-discipline              span() only as a context manager,
+                                      no tracing inside jit bodies or
+                                      Pallas kernel closures, and every
+                                      RIPTIDE_TRACE_*/RIPTIDE_PROM_* flag
+                                      registered in envflags.py
 ========  ==========================  =====================================
 
 Run via ``tools/riplint.py`` (GitHub-annotation output, checked-in
@@ -46,6 +51,7 @@ from .lock_discipline import LockDisciplineAnalyzer
 from .pallas_layout import PallasLayoutAnalyzer
 from .finite_guards import FiniteGuardAnalyzer
 from .liveness_guards import LivenessGuardAnalyzer
+from .obs_discipline import ObsDisciplineAnalyzer
 
 ALL_ANALYZERS = (
     HostSyncAnalyzer,
@@ -55,6 +61,7 @@ ALL_ANALYZERS = (
     PallasLayoutAnalyzer,
     FiniteGuardAnalyzer,
     LivenessGuardAnalyzer,
+    ObsDisciplineAnalyzer,
 )
 
 __all__ = [

@@ -1,0 +1,177 @@
+"""
+RIP008 — obs (tracing) discipline.
+
+The span tracer is threaded through every hot path of the survey
+pipeline, so its misuse modes are throughput or correctness bugs:
+
+* **span() only as a context manager** — a ``span(...)`` call that is
+  not the context expression of a ``with`` statement risks a manual
+  ``__enter__`` without a guaranteed ``__exit__``, which leaks the
+  per-thread span stack entry and corrupts nesting for every later
+  span on that thread;
+* **no tracing inside jit bodies or Pallas kernel closures** — spans
+  time *host-side* phases on wall clocks; inside a traced body the
+  call runs at trace time (measuring compilation, not execution) and
+  inside a kernel closure it is host nondeterminism baked into a
+  cached executable (the RIP005 failure class). Device-side timelines
+  belong to the ``jax.profiler`` exporter;
+* **every observability flag is registered** — ``RIPTIDE_TRACE_*`` /
+  ``RIPTIDE_PROM_*``-family tokens in package sources must name
+  entries of the
+  typed ``utils/envflags.py`` registry (RIP003 polices reads; this
+  closes the gap for names that only appear in docs strings or are
+  read through indirection).
+
+``riptide_tpu/obs/trace.py`` itself is exempt: it *implements* the
+span protocol (``Span.__enter__``/``__exit__`` live there by
+definition).
+"""
+import ast
+import re
+
+from .core import Analyzer, Finding, dotted, walk_functions
+from .env_flags import REGISTRY_REL, load_registry
+from .host_sync import _is_jit_decorated
+
+__all__ = ["ObsDisciplineAnalyzer"]
+
+# The module that implements the span protocol (and may therefore
+# mention manual enter/exit) — everything else must follow the rules.
+_EXEMPT = ("riptide_tpu/obs/trace.py",)
+
+# Tracing entry points that must never run inside traced/kernel code.
+_TRACE_CALLS = {"span", "get_tracer", "enable", "disable"}
+
+# A token ending in "_" is a docs-string wildcard ("RIPTIDE_TRACE_*"),
+# not a flag name.
+_OBS_TOKEN = re.compile(r"RIPTIDE_(?:TRACE|PROM)[A-Z0-9_]*")
+
+
+def _span_calls(tree):
+    """Every Call node whose callee leaf-name is ``span``."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            if name.split(".")[-1] == "span":
+                yield node
+
+
+def _with_context_exprs(tree):
+    """ids of every ``with``-item context expression in the module."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                out.add(id(item.context_expr))
+    return out
+
+
+def _kernel_closure_functions(ctx):
+    """{name: FunctionDef} of every module function reachable from a
+    Pallas kernel root (the function handed to ``pallas_call``) — the
+    same closure walk RIP005 uses for its nondeterminism check."""
+    from .pallas_layout import PallasLayoutAnalyzer
+
+    roots = PallasLayoutAnalyzer()._kernel_roots(ctx)
+    by_leaf = {}
+    for qual, fn in walk_functions(ctx.tree):
+        by_leaf.setdefault(qual.split(".")[-1], fn)
+    reach = {}
+    frontier = [r for r in roots if r in by_leaf]
+    while frontier:
+        name = frontier.pop()
+        if name in reach:
+            continue
+        reach[name] = by_leaf[name]
+        for node in ast.walk(by_leaf[name]):
+            if isinstance(node, ast.Call):
+                callee = (dotted(node.func) or "").split(".")[-1]
+                if callee in by_leaf and callee not in reach:
+                    frontier.append(callee)
+    return reach
+
+
+class ObsDisciplineAnalyzer(Analyzer):
+    rule = "RIP008"
+    name = "obs-discipline"
+    description = ("span() only as a context manager, no tracing calls "
+                   "inside jit bodies or Pallas kernel closures, every "
+                   "RIPTIDE_TRACE_*/RIPTIDE_PROM_* flag registered")
+
+    def __init__(self):
+        self._registry_flags = None
+
+    def begin(self, repo):
+        self._registry_flags = None
+
+    def _flags(self, repo):
+        if self._registry_flags is None:
+            try:
+                self._registry_flags = set(load_registry(repo).FLAGS)
+            except Exception:
+                # RIP003 reports a broken registry; don't double up.
+                self._registry_flags = frozenset()
+        return self._registry_flags
+
+    def run(self, ctx):
+        if ctx.relpath in _EXEMPT:
+            return []
+        findings = []
+
+        # -- span() must be a with-item ---------------------------------
+        as_context = _with_context_exprs(ctx.tree)
+        flagged = set()
+        for call in _span_calls(ctx.tree):
+            if id(call) not in as_context:
+                flagged.add(id(call))
+                findings.append(Finding.at(
+                    ctx, call, self.rule,
+                    "`span(...)` used outside a `with` statement — a "
+                    "manual __enter__ without a guaranteed __exit__ "
+                    "leaks the per-thread span stack; write "
+                    "`with span(...):`",
+                ))
+
+        # -- no tracing inside jit bodies / kernel closures --------------
+        def scan_scope(fn, where):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or id(node) in flagged:
+                    continue
+                name = dotted(node.func) or ""
+                if name.split(".")[-1] in _TRACE_CALLS:
+                    flagged.add(id(node))
+                    findings.append(Finding.at(
+                        ctx, node, self.rule,
+                        f"tracing call `{name}` inside {where} — spans "
+                        "time host-side phases only (in traced code "
+                        "this measures trace time; device timelines "
+                        "are the jax.profiler exporter's job)",
+                    ))
+
+        kernel_fns = _kernel_closure_functions(ctx)
+        for qual, fn in walk_functions(ctx.tree):
+            if _is_jit_decorated(fn):
+                scan_scope(fn, f"jit body `{qual}`")
+        for name, fn in sorted(kernel_fns.items()):
+            scan_scope(fn, f"Pallas kernel closure `{name}`")
+
+        # -- observability flag tokens must be registered ----------------
+        if ctx.relpath != REGISTRY_REL:
+            flags = self._flags(ctx.repo)
+            seen_lines = set()
+            for m in _OBS_TOKEN.finditer(ctx.source):
+                token = m.group(0)
+                if token.endswith("_") or token in flags:
+                    continue
+                line = ctx.source.count("\n", 0, m.start()) + 1
+                if (token, line) in seen_lines:
+                    continue
+                seen_lines.add((token, line))
+                findings.append(Finding(
+                    ctx.relpath, line, 0, self.rule,
+                    f"observability flag {token!r} is not in the "
+                    "utils/envflags.py registry — declare it (type, "
+                    "default, help) so the tracing/exposition surface "
+                    "stays enumerable",
+                ))
+        return findings

@@ -86,6 +86,20 @@ def get_parser():
         "loop: attempts plus backoff never exceed it, so a persistently "
         "failing search errors out instead of backing off forever",
     )
+    parser.add_argument(
+        "--trace", action="store_true",
+        help="Record host-side phase spans (prep/wire/dispatch/collect) "
+        "and write a Perfetto-loadable Chrome trace-event JSON next to "
+        "--journal (trace.json), or next to the input file "
+        "(<input>.trace.json) when not journaling",
+    )
+    parser.add_argument(
+        "--profile-dir", type=str, default=None,
+        help="Capture a jax.profiler device trace of the search into "
+        "this directory (kernel-level timeline; view with TensorBoard's "
+        "profile plugin or Perfetto) — the device-side complement of "
+        "--trace's host spans",
+    )
     parser.add_argument("fname", type=str,
                         help="Path of the time series file to search")
     parser.add_argument("--version", action="version", version=__version__)
@@ -155,16 +169,38 @@ def _search_with_survey_hooks(args, ts):
     faults.nan_inject(0, ts.data)
     metrics = get_metrics()
     retry = RetryPolicy(deadline_s=getattr(args, "deadline_s", None))
+    # Phase attribution via timer deltas: the engine records prep/wire/
+    # device seconds while the search runs; the deltas across this one
+    # work unit feed the journal's `timing` block (the same schema the
+    # survey scheduler journals per chunk).
+    prep0 = metrics.timer_total("prep_s")
+    wire0 = metrics.timer_total("wire_s")
+    dev0 = metrics.timer_total("device_s")
+    wb0 = metrics.counter("wire_bytes")
     t0 = time.perf_counter()
     peaks, attempts = run_with_retry(
         lambda: _search_peaks(args, ts), 0, retry, faults, metrics,
     )
+    chunk_s = time.perf_counter() - t0
     metrics.add("chunks_done")
-    metrics.observe("chunk_s", time.perf_counter() - t0)
+    metrics.observe("chunk_s", chunk_s)
     if journal is not None:
+        from riptide_tpu.obs.schema import chunk_timing
+
+        device_s = metrics.timer_total("device_s") - dev0
         journal.record_chunk(
             0, [args.fname], [float(ts.metadata["dm"] or 0.0)], peaks,
-            timings={"chunk_s": round(time.perf_counter() - t0, 6)},
+            timings=chunk_timing(
+                chunk_s,
+                prep_s=metrics.timer_total("prep_s") - prep0,
+                wire_s=metrics.timer_total("wire_s") - wire0,
+                device_s=device_s,
+                # The blocking device wait happens inside the search
+                # call's collect; attribute it there rather than to the
+                # host remainder.
+                collect_s=device_s,
+                wire_bytes=int(metrics.counter("wire_bytes") - wb0),
+            ),
             attempts=attempts,
         )
         journal.record_metrics(metrics.summary())
@@ -187,6 +223,14 @@ def run_program(args):
         format="%(asctime)s %(filename)18s:%(lineno)-4s %(levelname)-8s %(message)s",
     )
 
+    from riptide_tpu.obs import prom, trace
+    from riptide_tpu.timing import maybe_trace
+
+    trace_to = getattr(args, "trace", None)
+    if trace_to and not trace.enabled():
+        trace.enable()
+    prom.maybe_serve()
+
     loaders = {"sigproc": TimeSeries.from_sigproc, "presto": TimeSeries.from_presto_inf}
     ts = loaders[args.format](args.fname)
 
@@ -197,13 +241,31 @@ def run_program(args):
     from riptide_tpu.quality import QuarantinedSeries
 
     try:
-        peaks = _search_with_survey_hooks(args, ts)
+        with maybe_trace(getattr(args, "profile_dir", None)):
+            peaks = _search_with_survey_hooks(args, ts)
     except QuarantinedSeries as err:
         # Degraded beyond searchability: report, don't crash.
         log.error("input quarantined by the data-quality scan: %s",
                   err.report.to_dict())
         print(f"Input quarantined: {err.report.describe()}")
         return None
+    # Export whenever the tracer is live — via --trace OR RIPTIDE_TRACE=1
+    # — so environment-enabled runs don't record spans only to drop them.
+    if trace.enabled():
+        import os
+
+        from riptide_tpu.obs.chrome import write_chrome_trace
+
+        tracer = trace.get_tracer()
+        if args.journal:
+            trace_path = os.path.join(args.journal, "trace.json")
+        else:
+            trace_path = args.fname + ".trace.json"
+        if tracer is not None:
+            write_chrome_trace(trace_path, tracer)
+            log.info(f"host span trace written to {trace_path!r} "
+                     "(load in Perfetto or chrome://tracing)")
+    prom.maybe_write_textfile()
     if not peaks:
         print(f"No peaks found above S/N = {args.smin:.2f}")
         return None

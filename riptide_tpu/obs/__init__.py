@@ -1,0 +1,61 @@
+"""
+riptide_tpu.obs — tracing, exposition and per-phase attribution.
+
+The observability subsystem of the survey path, in four parts:
+
+* :mod:`~riptide_tpu.obs.trace` — a thread-safe span tracer
+  (``with span("phase", chunk=3):``) on monotonic clocks with a
+  bounded ring buffer, near-free when disabled (the default; enable
+  with ``RIPTIDE_TRACE=1`` or :func:`enable`). The survey layers call
+  :func:`span` unconditionally around every host phase: batcher
+  staging, wire encode/ship, each fused dispatch (tagged with the
+  dispatch kind and lane bucket), collect, clustering, journal writes.
+* :mod:`~riptide_tpu.obs.chrome` — Chrome trace-event JSON export of
+  the span ring (Perfetto-loadable; multihost runs write one file per
+  process and merge them with process-id lanes). Device-side timelines
+  are the ``jax.profiler`` hook's job
+  (:func:`riptide_tpu.timing.maybe_trace`, ``rseek --profile-dir`` /
+  ``rffa --trace-dir``); spans cover the HOST side the profiler
+  cannot attribute.
+* :mod:`~riptide_tpu.obs.prom` — Prometheus text-format exposition of
+  the metrics registry (counters/gauges/histograms), as an atomic
+  textfile and an optional stdlib-only localhost HTTP endpoint
+  (``RIPTIDE_PROM_PORT``).
+* :mod:`~riptide_tpu.obs.schema` — the ONE timing-key schema:
+  bench.py's best line, tools/stime.py's closing JSON block and the
+  journal's per-chunk ``timing`` record all derive from
+  :func:`~riptide_tpu.obs.schema.decomposition` /
+  :func:`~riptide_tpu.obs.schema.chunk_timing`, so every surface
+  reports identical keys (and the tunnel- vs device-bound
+  classification of each chunk).
+
+Discipline (riplint RIP008): ``span()`` only as a context manager,
+never inside jit-decorated bodies or Pallas kernel closures, and every
+``RIPTIDE_TRACE_*`` / ``RIPTIDE_PROM_*`` flag registered in the typed
+envflags registry.
+"""
+from .trace import (  # noqa: F401
+    NULL_SPAN, Span, Tracer, disable, enable, enabled, get_tracer,
+    set_tracer, span,
+)
+from .chrome import (  # noqa: F401
+    chrome_events, export_run_trace, merge_chrome_traces,
+    write_chrome_trace,
+)
+from .prom import (  # noqa: F401
+    maybe_serve, maybe_write_textfile, render, serve, write_prom,
+)
+from .schema import (  # noqa: F401
+    CHUNK_TIMING_KEYS, DECOMPOSITION_KEYS, LEGACY_ALIASES, PHASES,
+    TIMING_VERSION, chunk_timing, classify_bound, decomposition,
+)
+
+__all__ = [
+    "span", "enable", "disable", "enabled", "get_tracer", "set_tracer",
+    "Span", "Tracer", "NULL_SPAN",
+    "chrome_events", "write_chrome_trace", "merge_chrome_traces",
+    "export_run_trace",
+    "render", "write_prom", "serve", "maybe_serve", "maybe_write_textfile",
+    "TIMING_VERSION", "PHASES", "DECOMPOSITION_KEYS", "CHUNK_TIMING_KEYS",
+    "LEGACY_ALIASES", "decomposition", "chunk_timing", "classify_bound",
+]

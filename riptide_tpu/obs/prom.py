@@ -1,0 +1,215 @@
+"""
+Prometheus text-format exposition of the metrics registry.
+
+Grown out of :mod:`riptide_tpu.survey.metrics` rather than bolted on:
+the registry already records counters, gauges, timers and fixed-log-
+bucket histograms (every timer ``observe`` feeds its histogram, so a
+histogram's ``_sum`` always equals the timer's total seconds — the
+exposition cannot drift from the registry's own summary). This module
+only *renders* a snapshot:
+
+* :func:`render` — the text-format 0.0.4 page: counters as
+  ``riptide_<name>_total``, gauges as ``riptide_<name>``, histograms as
+  cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``
+  (timer names ending in ``_s`` render with a ``_seconds`` base unit);
+* :func:`write_prom` — atomic textfile write (node_exporter
+  textfile-collector format: tmp + rename, never a torn page);
+* :func:`serve` / :func:`maybe_serve` — an OPTIONAL stdlib-only
+  localhost HTTP endpoint serving ``/metrics`` from a daemon thread,
+  enabled by ``RIPTIDE_PROM_PORT`` — the daemon-ready half of the
+  survey-as-a-service roadmap item (a scraper polls a *running* survey
+  instead of waiting for its end-of-run snapshot);
+* :func:`maybe_write_textfile` — end-of-run textfile write when
+  ``RIPTIDE_PROM_TEXTFILE`` is set (survey scheduler / rseek hook).
+
+Everything here must stay importable without jax: exposition is host
+plumbing and the lint/daemon layers load it standalone.
+"""
+import logging
+import os
+import threading
+
+from ..survey.metrics import get_metrics
+from ..utils import envflags
+
+log = logging.getLogger("riptide_tpu.obs.prom")
+
+__all__ = ["render", "write_prom", "serve", "maybe_serve",
+           "maybe_write_textfile", "PROM_PREFIX"]
+
+PROM_PREFIX = "riptide"
+
+_HELP = {
+    "chunks_done": "chunks searched to completion",
+    "chunks_retried": "chunk dispatch attempts beyond the first",
+    "chunks_skipped": "chunks satisfied from the journal on resume",
+    "chunks_timed_out": "dispatch attempts abandoned by the watchdog",
+    "chunks_parked": "chunks set aside by the open circuit breaker",
+    "breaker_opens": "circuit-breaker transitions to open",
+    "peer_losses": "collectives degraded to local-only mode",
+    "oom_bisections": "DM-batch halvings after device OOM",
+    "wire_bytes": "bytes shipped over the host->device wire",
+    "queue_depth": "work items not yet collected",
+    "heartbeat_age_s": "age of the stalest peer heartbeat",
+}
+
+
+def _metric_name(name):
+    """Prometheus series name for a registry key: ``riptide_`` prefix,
+    a ``_seconds`` base unit for the package's ``*_s`` timer names, and
+    non-identifier characters mapped to ``_``."""
+    if name.endswith("_s"):
+        name = name[:-2] + "_seconds"
+    clean = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    return f"{PROM_PREFIX}_{clean}"
+
+
+def _fmt(value):
+    """Prometheus float rendering: integers without a trailing ``.0``
+    (bucket counts must parse as exact counts), floats via repr."""
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int) or (isinstance(value, float)
+                                  and value.is_integer()):
+        return str(int(value))
+    return repr(float(value))
+
+
+def render(registry=None):
+    """The full text-format page of one registry snapshot (counters,
+    gauges, histograms — timers are covered by their histograms, whose
+    ``_sum`` equals the timer total by construction)."""
+    snap = (registry or get_metrics()).snapshot()
+    lines = []
+
+    def head(name, kind, key):
+        help_text = _HELP.get(key, f"riptide_tpu registry metric {key!r}")
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+
+    for key in sorted(snap["counters"]):
+        name = _metric_name(key) + "_total"
+        head(name, "counter", key)
+        lines.append(f"{name} {_fmt(snap['counters'][key])}")
+
+    for key in sorted(snap["gauges"]):
+        name = _metric_name(key)
+        head(name, "gauge", key)
+        lines.append(f"{name} {_fmt(snap['gauges'][key])}")
+
+    for key in sorted(snap["hists"]):
+        h = snap["hists"][key]
+        name = _metric_name(key)
+        head(name, "histogram", key)
+        cum = 0
+        for le, count in zip(h["buckets"], h["counts"]):
+            cum += count
+            lines.append(f'{name}_bucket{{le="{_fmt(le)}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{name}_sum {_fmt(h['sum'])}")
+        lines.append(f"{name}_count {h['count']}")
+
+    return "\n".join(lines) + "\n"
+
+
+def write_prom(path, registry=None):
+    """Atomically write the exposition page to ``path`` (textfile-
+    collector format: a scraper never reads a torn page)."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fobj:
+        fobj.write(render(registry))
+    os.replace(tmp, path)
+    return path
+
+
+def maybe_write_textfile(registry=None):
+    """Write the page to ``RIPTIDE_PROM_TEXTFILE`` when set (end-of-run
+    hook of the survey scheduler and rseek); returns the path or None."""
+    path = envflags.get("RIPTIDE_PROM_TEXTFILE")
+    if not path:
+        return None
+    return write_prom(path, registry)
+
+
+class _PromServer:
+    """Localhost /metrics endpoint on a daemon thread. ``close()`` is
+    idempotent; ``port`` is the bound port (useful with port 0)."""
+
+    def __init__(self, port, registry=None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                # Resolved at request time, not server start: a later
+                # set_registry (or, unpinned, a set_metrics swap) shows
+                # up on the next scrape instead of serving a registry
+                # frozen at whatever the first caller passed.
+                body = render(self.server._riptide_registry).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                log.debug("prom endpoint: " + fmt, *args)
+
+        # Loopback only: exposition is operator plumbing, not a public
+        # service; binding wider is a deliberate reverse-proxy decision.
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", int(port)), Handler)
+        self._httpd._riptide_registry = registry
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="riptide-prom-endpoint", daemon=True,
+        )
+        self._thread.start()
+
+    def set_registry(self, registry):
+        """Re-point /metrics at ``registry`` (None = the process-wide
+        default via :func:`get_metrics`, looked up per scrape)."""
+        self._httpd._riptide_registry = registry
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def serve(port, registry=None):
+    """Start a /metrics endpoint on 127.0.0.1:``port`` (0 = ephemeral);
+    returns the server object (``.port``, ``.close()``)."""
+    return _PromServer(port, registry=registry)
+
+
+# Process-wide endpoint handle for maybe_serve (one per process; a
+# second survey run in the same process reuses it).
+_server = None
+_server_lock = threading.Lock()
+
+
+def maybe_serve(registry=None):
+    """Start the process-wide endpoint when ``RIPTIDE_PROM_PORT`` > 0
+    and none is running yet; returns the server or None. Survey entry
+    points call this unconditionally — the disabled path is one flag
+    read. A caller with an explicit ``registry`` re-points a running
+    endpoint (last caller wins), so a scheduler constructed with its
+    own registry is the one a scraper sees during its run."""
+    global _server
+    port = envflags.get("RIPTIDE_PROM_PORT")
+    if not port or port <= 0:
+        return _server
+    with _server_lock:
+        if _server is None:
+            _server = serve(port, registry=registry)
+            log.info("Prometheus endpoint on http://127.0.0.1:%d/metrics",
+                     _server.port)
+        elif registry is not None:
+            _server.set_registry(registry)
+    return _server

@@ -1,0 +1,115 @@
+"""
+The ONE timing-key schema of the survey path.
+
+``bench.py``'s best-line re-emit, ``tools/stime.py``'s closing JSON
+block and the journal's per-chunk ``timing`` record historically built
+their key sets independently; this module is now the single definition
+both import, so a dashboard (or the driver log parser) reads identical
+keys everywhere.
+
+Two shapes:
+
+* **run decomposition** (:func:`decomposition`) — where a whole timed
+  pass went, derived from the metrics-registry summary: ``prep_s`` /
+  ``wire_s`` / ``device_s`` totals, the achieved ``wire_MBps``, and the
+  steady-state per-chunk cost ``chunk_s``. This is the block bench.py
+  and stime.py append to their JSON lines.
+* **per-chunk timing** (:func:`chunk_timing`) — one chunk's phase
+  split as journaled by the survey scheduler: ``prep_s`` (host staging,
+  OVERLAPPED with the previous chunk's device work — deliberately not
+  part of the wall-clock sum), then the serial phases ``wire_s``
+  (ship), ``queue_s`` (dispatch enqueue), ``device_s`` (blocking device
+  wait inside collect), ``collect_s`` (full collect call: device wait
+  plus host peak decode) and ``host_s`` (everything else on the
+  dispatch path: digest checks, fault hooks, retries' bookkeeping).
+  ``wire_s + queue_s + collect_s + host_s == chunk_s`` by construction,
+  so the decomposition always sums to the measured wall-clock. Each
+  block also carries the chunk's achieved ``wire_MBps`` and a
+  ``bound`` classification (tunnel- vs device-bound — the 4-70 MB/s
+  tunnel swing is the bench's dominant noise source, and this field
+  makes it attributable per chunk).
+
+Key stability: the names above ARE the historical bench/stime keys
+(``device_s``/``prep_s``/``wire_MBps``/``chunk_s``), kept verbatim —
+:data:`LEGACY_ALIASES` records the one-release aliasing contract for
+any key this schema ever renames (currently none; consumers should
+treat an alias's presence as deprecation notice for the old name).
+"""
+
+__all__ = [
+    "TIMING_VERSION", "PHASES", "DECOMPOSITION_KEYS", "CHUNK_TIMING_KEYS",
+    "LEGACY_ALIASES", "decomposition", "chunk_timing", "classify_bound",
+]
+
+TIMING_VERSION = 1
+
+# Phase names, in pipeline order (span names and timing-key prefixes).
+PHASES = ("prep", "wire", "queue", "device", "collect", "host")
+
+# Keys of a run-level decomposition block (bench.py / stime.py).
+DECOMPOSITION_KEYS = ("prep_s", "wire_s", "device_s", "chunk_s",
+                      "wire_MBps")
+
+# Keys of a journal chunk record's `timing` block.
+CHUNK_TIMING_KEYS = ("prep_s", "wire_s", "queue_s", "device_s",
+                     "collect_s", "host_s", "chunk_s", "wire_MBps",
+                     "bound")
+
+# old key -> canonical key, kept readable for one release after a
+# rename. Empty today: the schema adopted the historical names.
+LEGACY_ALIASES = {}
+
+# A chunk whose wire time rivals its device time is throughput-bound on
+# the host->device tunnel, not on compute. The margin keeps borderline
+# chunks from flapping between labels on timer noise.
+_TUNNEL_BOUND_RATIO = 0.8
+
+
+def classify_bound(wire_s, device_s):
+    """``"tunnel"`` when the wire transfer dominates (wire_s >= 0.8 x
+    device_s), ``"device"`` otherwise — or ``"unknown"`` when no
+    device time was measured at all (e.g. a path that never blocks on
+    the device timer), where a ratio against zero would always scream
+    "tunnel"."""
+    if device_s <= 0.0:
+        return "unknown"
+    if wire_s >= _TUNNEL_BOUND_RATIO * device_s:
+        return "tunnel"
+    return "device"
+
+
+def decomposition(summary, nchunks, elapsed):
+    """Run-level decomposition block from a metrics-registry
+    :meth:`~riptide_tpu.survey.metrics.MetricsRegistry.summary` dict:
+    the identical keys bench.py emits on its best line and stime.py in
+    its closing JSON block."""
+    return {
+        "prep_s": round(summary.get("prep_s", 0.0), 3),
+        "wire_s": round(summary.get("wire_s", 0.0), 3),
+        "device_s": round(summary.get("device_s", 0.0), 3),
+        "chunk_s": round(elapsed / max(nchunks, 1), 3),
+        "wire_MBps": summary.get("wire_MBps"),
+    }
+
+
+def chunk_timing(chunk_s, prep_s=0.0, wire_s=0.0, queue_s=0.0,
+                 device_s=0.0, collect_s=0.0, wire_bytes=0):
+    """One chunk's journal ``timing`` block. ``host_s`` is the serial
+    remainder (``chunk_s`` minus ship/queue/collect), clamped at zero
+    against timer skew, so the serial phases always sum to the measured
+    wall-clock. ``prep_s`` is reported but excluded from the sum — host
+    staging overlaps the previous chunk's device execution."""
+    host_s = max(0.0, chunk_s - wire_s - queue_s - collect_s)
+    out = {
+        "prep_s": round(prep_s, 6),
+        "wire_s": round(wire_s, 6),
+        "queue_s": round(queue_s, 6),
+        "device_s": round(device_s, 6),
+        "collect_s": round(collect_s, 6),
+        "host_s": round(host_s, 6),
+        "chunk_s": round(chunk_s, 6),
+        "bound": classify_bound(wire_s, device_s),
+    }
+    if wire_bytes and wire_s > 0:
+        out["wire_MBps"] = round(wire_bytes / 1e6 / wire_s, 3)
+    return out

@@ -34,6 +34,7 @@ import numpy as np
 
 import jax
 
+from ..obs.trace import span
 from ..peak_detection import PEAK_FIELDS, PEAK_INT_FIELDS, Peak
 from ..survey.liveness import PeerTimeout, bounded_allgather
 from ..survey.metrics import get_metrics
@@ -124,7 +125,8 @@ def gather_peaks(local_peaks, faults=None, chunk_id=0, timeout_s=None,
     try:
         if faults is not None:
             faults.before_gather(chunk_id)
-        with get_metrics().timer("gather_s"):
+        with get_metrics().timer("gather_s"), \
+                span("gather", chunk=chunk_id):
             arr = _encode(local_peaks)
             counts = _allgather(
                 np.asarray([arr.shape[0]], np.int64), timeout_s,
@@ -215,4 +217,15 @@ def run_search_multihost(plan, batch_local, tobs, dms_local=None,
         )
         journal.record_metrics(metrics.summary())
         metrics.add("chunks_done")
+    if journal is not None:
+        # EVERY process (not just the journal writer) exports its own
+        # host-span lane file next to the journal; process 0 merges the
+        # lanes present so far into trace.json. Rewritten atomically
+        # after each chunk — like a heartbeat, the trace survives a
+        # kill. No-op while tracing is disabled.
+        from ..obs.chrome import export_run_trace
+
+        export_run_trace(journal.directory,
+                         process_index=jax.process_index(),
+                         process_count=jax.process_count())
     return peaks, polycos

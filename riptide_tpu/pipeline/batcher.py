@@ -31,6 +31,7 @@ import numpy as np
 
 from .. import quality
 from ..ffautils import generate_width_trials
+from ..obs.trace import span
 from ..search import periodogram_plan
 from ..search.engine import (
     collect_search_batch, is_oom_error, queue_search_batch,
@@ -199,14 +200,19 @@ class BatchSearcher:
                 ThreadPoolExecutor(max_workers=self.io_threads) as loaders:
 
             def stage_chunk(fnames, cid):
-                tslist = list(loaders.map(
-                    lambda f: self.load_prepared(f, chunk_id=cid), fnames
-                ))
-                items = self._prepare_chunk(tslist)
-                return shipper.submit(self._ship_chunk, items)
+                # Staging span on the stager thread: load + DQ + detrend
+                # + wire-prep of chunk `cid`, overlapping the device.
+                with span("stage", chunk=cid):
+                    tslist = list(loaders.map(
+                        lambda f: self.load_prepared(f, chunk_id=cid),
+                        fnames
+                    ))
+                    items = self._prepare_chunk(tslist)
+                return shipper.submit(self._ship_spanned, items, cid)
 
-            def drain(queued, t_queued):
-                peaks.extend(self._collect_chunk(queued))
+            def drain(queued, t_queued, cid):
+                with span("collect", chunk=cid):
+                    peaks.extend(self._collect_chunk(queued))
                 metrics.add("chunks_done")
                 if self.watchdog is not None:
                     # Prime the liveness EWMA with this chunk's queue->
@@ -230,16 +236,17 @@ class BatchSearcher:
                 # i-1: the device stays busy while the host pays the
                 # previous chunk's result round trip.
                 t_nxt = time.perf_counter()
-                nxt = self._queue_chunk(items)
+                with span("queue", chunk=i):
+                    nxt = self._queue_chunk(items)
                 if queued is not None:
-                    drain(queued, t_queued)
+                    drain(queued, t_queued, i - 1)
                 queued, t_queued = nxt, t_nxt
                 log.debug(
                     f"Chunk {i + 1}/{len(chunks)} ({len(chunk)} files) "
                     f"queued, total peaks: {len(peaks)}"
                 )
             if queued is not None:
-                drain(queued, t_queued)
+                drain(queued, t_queued, len(chunks) - 1)
             metrics.set_gauge("queue_depth", 0)
         return peaks
 
@@ -297,6 +304,13 @@ class BatchSearcher:
                     prepared = prepare_stage_data(plan, batch)
                 items.append((members, batch, conf, plan, prepared))
         return items
+
+    def _ship_spanned(self, items, cid):
+        """_ship_chunk wrapped in a chunk-tagged wire span (runs on the
+        dedicated ship thread, so the span lands in that thread's
+        lane)."""
+        with span("ship", chunk=cid):
+            return self._ship_chunk(items)
 
     def _ship_chunk(self, items):
         """Wire half of one chunk (runs on the dedicated ship thread):

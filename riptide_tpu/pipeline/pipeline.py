@@ -120,13 +120,20 @@ class Pipeline:
     fault_spec : str or None
         Fault-injection spec (see :mod:`riptide_tpu.survey.faults`);
         defaults to the ``RIPTIDE_FAULT_INJECT`` environment variable.
+    trace : bool
+        Record host-side phase spans (:mod:`riptide_tpu.obs`) for the
+        whole run and export a Perfetto-loadable Chrome trace next to
+        the journal (or into the output directory). Equivalent to
+        ``RIPTIDE_TRACE=1``; ``trace_dir`` remains the device-side
+        jax.profiler capture.
     """
 
     def __init__(self, config, mesh=None, trace_dir=None, journal=None,
-                 resume=False, fault_spec=None):
+                 resume=False, fault_spec=None, trace=False):
         self.config = validate_pipeline_config(config)
         self.mesh = mesh
         self.trace_dir = trace_dir
+        self.trace = bool(trace)
         self.journal_dir = journal
         self.resume = bool(resume)
         self.fault_spec = (fault_spec if fault_spec is not None
@@ -498,13 +505,32 @@ class Pipeline:
         """Run all stages. Candidate filters apply *after* harmonic
         flagging so e.g. a bright zero-DM signal still claims its
         harmonics before any DM cut removes it."""
+        from ..obs import chrome, prom
+        from ..obs.trace import enabled, enable, span
+
+        if self.trace and not enabled():
+            enable()
+        prom.maybe_serve()
         self.prepare(files)
         self.search()
-        self.cluster_peaks()
-        self.flag_harmonics()
+        # Post-search stages run on KB-scale host peak lists; one span
+        # each is enough to show their share of the run's host tail.
+        with span("cluster_peaks"):
+            self.cluster_peaks()
+        with span("flag_harmonics"):
+            self.flag_harmonics()
         self.apply_candidate_filters()
-        self.build_candidates()
-        self.save_products(outdir=outdir)
+        with span("build_candidates"):
+            self.build_candidates()
+        with span("save_products"):
+            self.save_products(outdir=outdir)
+        # The scheduler exported a search-stage trace next to the
+        # journal; re-export after the post-search stages so the
+        # cluster/candidate/save host-tail spans land in the same file.
+        # Un-journaled runs get theirs in the output directory. Both
+        # are no-ops while tracing is disabled.
+        chrome.export_run_trace(self.journal_dir or outdir or os.getcwd())
+        prom.maybe_write_textfile()
 
     @classmethod
     def from_yaml_config(cls, fname, mesh=None, **kwargs):
@@ -548,6 +574,11 @@ def get_parser():
                         help="Capture a jax.profiler device trace of the "
                              "search stage into this directory (view with "
                              "TensorBoard's profile plugin or Perfetto)")
+    parser.add_argument("--trace", action="store_true",
+                        help="Record host-side phase spans (prep/wire/"
+                             "dispatch/collect per chunk) and write a "
+                             "Perfetto-loadable Chrome trace-event JSON "
+                             "next to the journal (or into --outdir)")
     parser.add_argument("--journal", type=str, default=None,
                         help="Survey journal directory: checkpoint each "
                              "completed DM chunk (with retry/backoff around "
@@ -590,6 +621,7 @@ def run_program(args):
         journal=getattr(args, "journal", None),
         resume=getattr(args, "resume", False),
         fault_spec=getattr(args, "fault_inject", None),
+        trace=getattr(args, "trace", False),
     )
     pipeline.trace_dir = getattr(args, "trace_dir", None)
     pipeline.process(args.files, args.outdir)

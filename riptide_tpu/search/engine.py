@@ -33,6 +33,7 @@ import numpy as np
 
 log = logging.getLogger("riptide_tpu.search.engine")
 
+from ..obs.trace import span
 from ..ops.downsample import downsample_gather, split_prefix_sums
 from ..survey.metrics import get_metrics
 from ..utils import envflags
@@ -651,9 +652,13 @@ def _run_stage_fused(st, wire_part, roff, plan, meta, i):
     nre = len(st.rows_eval)
     sv = _stagevec(st, vl, i, roff, meta["mode"])
     outs = []
-    for idx, kern in st.cycle_kernels(interpret=interpret):
-        out = kern.run_fused(sv, wire_part, meta["scales_dev"],
-                             meta["mode"])
+    for k, (idx, kern) in enumerate(st.cycle_kernels(interpret=interpret)):
+        # Enqueue-side span: times the (async) dispatch call itself,
+        # tagged with the dispatch kind + lane bucket so a trace shows
+        # which buckets dominate queueing cost. Never a sync point.
+        with span("dispatch", kind="fused", stage=i, bucket=k):
+            out = kern.run_fused(sv, wire_part, meta["scales_dev"],
+                                 meta["mode"])
         _count_dispatch("fused")
         remax = max([st.rows_eval[g] for g in idx if g < nre] or [0])
         outs.append(out[..., : max(remax, 1), :nw])
@@ -673,16 +678,19 @@ def _run_stage_kernel(st, flat_dev, off, plan, meta, i):
     interpret = jax.default_backend() == "cpu"
     kern = st.cycle_kernel(interpret=interpret)
     shapes = tuple(zip(st.ms_padded, st.ps_padded))
-    if meta["mode"] in _WIRE_Q:
-        vl = meta["view"]
-        x = _pack_static_view(flat_dev, meta["scales_dev"], meta["mode"],
-                              off, vl["wrows"][i], int(vl["soffs"][i]),
-                              vl["r0s"][i], st.n, shapes, kern.rows,
-                              kern.P)
-    else:
-        x = _pack_static(flat_dev, off, st.n, shapes, kern.rows, kern.P)
+    with span("dispatch", kind="pack", stage=i):
+        if meta["mode"] in _WIRE_Q:
+            vl = meta["view"]
+            x = _pack_static_view(flat_dev, meta["scales_dev"],
+                                  meta["mode"], off, vl["wrows"][i],
+                                  int(vl["soffs"][i]), vl["r0s"][i], st.n,
+                                  shapes, kern.rows, kern.P)
+        else:
+            x = _pack_static(flat_dev, off, st.n, shapes, kern.rows,
+                             kern.P)
     _count_dispatch("pack")
-    out = kern(x)
+    with span("dispatch", kind="kernel", stage=i):
+        out = kern(x)
     _count_dispatch("kernel")
     out = out[..., : max(st.rows_eval_max, 1), : len(plan.widths)]
     _count_dispatch("slice")
@@ -788,21 +796,23 @@ def prepare_stage_data(plan, batch, mode=None):
     t0 = time.perf_counter()
     path = _ffa_path()
     mode = mode or _wire_mode(path)
-    offs, lens, tot = _wire_layout(plan, mode)
-    scales = None
-    if mode in _WIRE_Q:
-        flat, scales = _prepare_uint(plan, batch, mode)
-        meta = {"path": path, "mode": mode, "offs": offs, "lens": lens,
-                "scales": scales, "view": _view_layout(plan, mode)}
-    else:
-        wire = np.dtype(mode)
-        xds = _host_downsample_all(plan, batch, wire)
-        D = batch.shape[0]
-        flat = np.empty((D, tot), wire)
-        for i, st in enumerate(plan.stages):
-            flat[:, offs[i] : offs[i] + st.n] = xds[i][..., : st.n]
-        meta = {"path": path, "mode": mode, "offs": offs, "lens": lens,
-                "scales": None}
+    with span("prep", mode=mode):
+        offs, lens, tot = _wire_layout(plan, mode)
+        scales = None
+        if mode in _WIRE_Q:
+            flat, scales = _prepare_uint(plan, batch, mode)
+            meta = {"path": path, "mode": mode, "offs": offs,
+                    "lens": lens, "scales": scales,
+                    "view": _view_layout(plan, mode)}
+        else:
+            wire = np.dtype(mode)
+            xds = _host_downsample_all(plan, batch, wire)
+            D = batch.shape[0]
+            flat = np.empty((D, tot), wire)
+            for i, st in enumerate(plan.stages):
+                flat[:, offs[i] : offs[i] + st.n] = xds[i][..., : st.n]
+            meta = {"path": path, "mode": mode, "offs": offs,
+                    "lens": lens, "scales": None}
     get_metrics().observe("prep_s", time.perf_counter() - t0)
     return flat, meta
 
@@ -844,23 +854,30 @@ def ship_stage_data(plan, prepared):
     one computes."""
     flat, meta = prepared
     t0 = time.perf_counter()
-    parts = []
-    part_of = {}
-    for c, (start, end, stages) in enumerate(_wire_parts(plan,
-                                                         meta["mode"])):
-        # Both layouts split on axis 1 (elements of the flat float
-        # buffer / rows of the byte-plane view).
-        parts.append(jnp.asarray(flat[:, start:end]))
-        for i, off in stages:
-            part_of[i] = (c, off)
-    meta = dict(meta)
-    if meta["scales"] is not None:
-        # (D, STOT, 1): the trailing unit axis gives the fused kernel's
-        # per-row scale DMA a 2-D (R0, 1) destination.
-        meta["scales_dev"] = jnp.asarray(meta["scales"][..., None])
+    with span("wire", bytes=int(flat.nbytes)):
+        parts = []
+        part_of = {}
+        for c, (start, end, stages) in enumerate(_wire_parts(plan,
+                                                             meta["mode"])):
+            # Both layouts split on axis 1 (elements of the flat float
+            # buffer / rows of the byte-plane view).
+            parts.append(jnp.asarray(flat[:, start:end]))
+            for i, off in stages:
+                part_of[i] = (c, off)
+        meta = dict(meta)
+        if meta["scales"] is not None:
+            # (D, STOT, 1): the trailing unit axis gives the fused
+            # kernel's per-row scale DMA a 2-D (R0, 1) destination.
+            meta["scales_dev"] = jnp.asarray(meta["scales"][..., None])
+    elapsed = time.perf_counter() - t0
     reg = get_metrics()
-    reg.observe("wire_s", time.perf_counter() - t0)
+    reg.observe("wire_s", elapsed)
     reg.add("wire_bytes", int(flat.nbytes))
+    if elapsed > 0:
+        # Per-chunk tunnel-rate sample: the histogram of these is how
+        # the bench's dominant noise source (the 4-70 MB/s transfer
+        # swing) becomes attributable after the fact.
+        reg.observe_hist("wire_MBps", flat.nbytes / 1e6 / elapsed)
     return parts, part_of, meta
 
 
@@ -896,23 +913,28 @@ def _queue_stages(plan, batch, prepared=None, shipped=None):
                                            i),))
         elif mode in _WIRE_Q:
             vl = meta["view"]
-            xd = _unpack_view_padded(parts[c], meta["scales_dev"], mode,
-                                     off, vl["wrows"][i],
-                                     int(vl["soffs"][i]), vl["r0s"][i],
-                                     st.n, plan.nout)
+            with span("dispatch", kind="unpack", stage=i):
+                xd = _unpack_view_padded(parts[c], meta["scales_dev"],
+                                         mode, off, vl["wrows"][i],
+                                         int(vl["soffs"][i]), vl["r0s"][i],
+                                         st.n, plan.nout)
             _count_dispatch("unpack")
-            outs.append((_run_stage_gather(st, xd, plan),))
+            with span("dispatch", kind="gather", stage=i):
+                outs.append((_run_stage_gather(st, xd, plan),))
             _count_dispatch("gather")
         else:
             # Gather-path programs are keyed by series length: restore
             # the plan-wide padded length so all stages share one
             # compiled program. Also promote a float16 wire back to
             # float32 — the gather path accumulates in its input dtype.
-            xd = jax.lax.slice_in_dim(parts[c], off, off + st.n, axis=-1)
-            xd = jnp.pad(xd.astype(jnp.float32),
-                         [(0, 0), (0, plan.nout - st.n)])
+            with span("dispatch", kind="unpack", stage=i):
+                xd = jax.lax.slice_in_dim(parts[c], off, off + st.n,
+                                          axis=-1)
+                xd = jnp.pad(xd.astype(jnp.float32),
+                             [(0, 0), (0, plan.nout - st.n)])
             _count_dispatch("unpack")
-            outs.append((_run_stage_gather(st, xd, plan),))
+            with span("dispatch", kind="gather", stage=i):
+                outs.append((_run_stage_gather(st, xd, plan),))
             _count_dispatch("gather")
     return outs, tuple(layout)
 
@@ -940,7 +962,9 @@ def collect_search_batch(handle, dms):
     from .peaks_device import collect_peaks
 
     pp, peaks_handle = handle
-    with get_metrics().timer("device_s"):
+    # A sanctioned sync point: the span and the device_s timer cover
+    # the same blocking device wait + single result pull.
+    with get_metrics().timer("device_s"), span("device"):
         return collect_peaks(pp, peaks_handle, dms)
 
 

@@ -37,6 +37,7 @@ import numpy as np
 from functools import partial
 
 from ..clustering import cluster1d
+from ..obs.trace import span
 from ..utils.exec_cache import cached_jit
 from ..peak_detection import Peak, fit_threshold
 
@@ -361,9 +362,13 @@ def collect_peaks(peak_plan, handle, dms):
         for row, (d, iw, b) in zip(gvals, sel):
             add(d, iw, b, row)
 
-    return peak_plan._finalize(
-        cols, polyco, plan.widths, plan.all_foldbins, dms, D, NW
-    )
+    # Host tail of the collect: exact float64 threshold re-check +
+    # friends-of-friends clustering (ROADMAP item 5 targets exactly
+    # this span, so it must be separable from the device wait above).
+    with span("cluster", trials=int(D)):
+        return peak_plan._finalize(
+            cols, polyco, plan.widths, plan.all_foldbins, dms, D, NW
+        )
 
 
 def device_find_peaks(peak_plan, snr_dev, dms):

@@ -7,7 +7,10 @@ Two JSONL files live in the journal directory:
   survey (an identity digest over the input files and search config,
   so a journal cannot silently resume a different survey), one
   ``chunk`` record per completed work unit (chunk id, input files, DM
-  values, wire digest, peak-store offsets, attempt count, timings),
+  values, wire digest, peak-store offsets, attempt count, a ``timings``
+  phase decomposition — see :mod:`riptide_tpu.obs.schema` — and a UTC
+  ISO-8601 wall-clock stamp; readers tolerate records without the
+  newer fields, so pre-existing journals resume unchanged),
   ``parked`` records for chunks the circuit breaker set aside (a
   parked chunk has no completed record, so a later resume re-dispatches
   it) and optional ``metrics`` snapshots.
@@ -34,6 +37,7 @@ treated as never completed and re-dispatched by the scheduler.
 import json
 import logging
 import os
+from datetime import datetime, timezone
 
 from ..peak_detection import PEAK_FIELDS, PEAK_INT_FIELDS, Peak
 
@@ -47,6 +51,16 @@ JOURNAL_VERSION = 1
 class JournalMismatch(ValueError):
     """The journal on disk belongs to a different survey (different
     input files or search config)."""
+
+
+def _utc_iso():
+    """UTC wall-clock timestamp, ISO-8601 with a Z suffix. Journal and
+    heartbeat records carry one for operators correlating a survey with
+    external logs; monotonic deltas stay authoritative for DURATIONS
+    (wall clocks step under NTP). Readers must tolerate records without
+    it — journals written before this field existed resume fine."""
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] \
+        + "Z"
 
 
 def _append_lines(path, objs):
@@ -158,6 +172,7 @@ class SurveyJournal:
         _append_line(self.journal_path, {
             "kind": "header", "version": JOURNAL_VERSION,
             "survey_id": survey_id, "chunks_total": int(chunks_total),
+            "utc": _utc_iso(),
         })
 
     def record_chunk(self, chunk_id, files, dms, peaks, wire_digest=None,
@@ -174,6 +189,7 @@ class SurveyJournal:
         self._peak_rows = offset + len(peaks)
         rec = {
             "kind": "chunk", "chunk_id": int(chunk_id),
+            "utc": _utc_iso(),
             "files": [os.path.basename(f) for f in files],
             "dms": [float(d) for d in dms],
             "wire_digest": wire_digest,
@@ -192,13 +208,14 @@ class SurveyJournal:
         — but it makes the degraded run auditable."""
         _append_line(self.journal_path, {
             "kind": "parked", "chunk_id": int(chunk_id),
-            "reason": str(reason),
+            "utc": _utc_iso(), "reason": str(reason),
             "files": [os.path.basename(f) for f in files or []],
         })
 
     def record_metrics(self, summary):
         """Append a metrics snapshot (see MetricsRegistry.summary)."""
         _append_line(self.journal_path, {"kind": "metrics",
+                                         "utc": _utc_iso(),
                                          "summary": summary})
 
     def heartbeat(self, process_index, ts=None):
@@ -210,7 +227,9 @@ class SurveyJournal:
         p = int(process_index)
         _append_line(
             os.path.join(self.directory, f"heartbeat_{p:04d}.jsonl"),
-            {"process": p, "ts": float(ts if ts is not None else time.time())},
+            {"process": p,
+             "ts": float(ts if ts is not None else time.time()),
+             "utc": _utc_iso()},
         )
 
     # -- reading ------------------------------------------------------------

@@ -45,12 +45,37 @@ schema.
 
 Derived rates (e.g. ``wire_MBps``, ``dq_masked_frac``) are computed by
 :meth:`summary`, not stored.
+
+Timers additionally feed fixed-log-bucket **histograms** (Prometheus
+semantics: per-bucket counts + exact sum + count), so the obs layer's
+text exposition (:mod:`riptide_tpu.obs.prom`) can serve latency
+distributions — not just totals — without a second recording path.
+Because every ``observe`` lands in both the timer and its histogram,
+a histogram's ``_sum`` always equals the timer's total seconds.
+Non-timer distributions (e.g. per-chunk ``wire_MBps``) record through
+:meth:`observe_hist`.
 """
+import bisect
 import threading
 import time
 from contextlib import contextmanager
 
-__all__ = ["MetricsRegistry", "get_metrics", "set_metrics"]
+__all__ = ["MetricsRegistry", "get_metrics", "set_metrics",
+           "TIME_BUCKETS", "RATE_BUCKETS"]
+
+# Fixed log buckets (Prometheus `le` upper bounds, +Inf implied).
+# Durations: 1 ms .. ~17 min in 4x steps — spans a CPU-test chunk
+# (~ms) through a tunneled-device survey chunk (~100 s).
+TIME_BUCKETS = (0.001, 0.004, 0.016, 0.064, 0.25, 1.0, 4.0, 16.0,
+                64.0, 256.0, 1024.0)
+# Rates in MB/s: 0.5 .. 1024 in 2x steps — brackets the device
+# tunnel's observed 4-70 MB/s swing with headroom both ways.
+RATE_BUCKETS = (0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                256.0, 512.0, 1024.0)
+
+# Metric-name -> bucket ladder; anything unlisted uses TIME_BUCKETS
+# (every timer is a duration unless declared otherwise).
+HIST_BUCKETS = {"wire_MBps": RATE_BUCKETS}
 
 
 class MetricsRegistry:
@@ -61,6 +86,9 @@ class MetricsRegistry:
         self._counters = {}
         self._timers = {}  # name -> [total_seconds, count]
         self._gauges = {}
+        # name -> [per-bucket counts (len(buckets) + 1, last = overflow),
+        #          sum, count]; buckets per HIST_BUCKETS.
+        self._hists = {}
 
     # -- recording ----------------------------------------------------------
 
@@ -70,11 +98,30 @@ class MetricsRegistry:
             self._counters[name] = self._counters.get(name, 0) + value
 
     def observe(self, name, seconds):
-        """Accumulate ``seconds`` into timer ``name``."""
+        """Accumulate ``seconds`` into timer ``name`` (and its
+        histogram — one recording call feeds both, so the histogram sum
+        can never drift from the timer total)."""
         with self._lock:
             t = self._timers.setdefault(name, [0.0, 0])
             t[0] += float(seconds)
             t[1] += 1
+            self._hist_observe_locked(name, float(seconds))
+
+    def observe_hist(self, name, value):
+        """Record ``value`` into histogram ``name`` only (non-timer
+        distributions, e.g. the per-chunk achieved ``wire_MBps``)."""
+        with self._lock:
+            self._hist_observe_locked(name, float(value))
+
+    def _hist_observe_locked(self, name, value):
+        h = self._hists.get(name)
+        if h is None:
+            nb = len(HIST_BUCKETS.get(name, TIME_BUCKETS))
+            h = self._hists[name] = [[0] * (nb + 1), 0.0, 0]
+        buckets = HIST_BUCKETS.get(name, TIME_BUCKETS)
+        h[0][bisect.bisect_left(buckets, value)] += 1
+        h[1] += value
+        h[2] += 1
 
     @contextmanager
     def timer(self, name):
@@ -95,9 +142,22 @@ class MetricsRegistry:
         with self._lock:
             return self._counters.get(name, default)
 
+    def timer_total(self, name, default=0.0):
+        """Accumulated seconds of timer ``name`` (0.0 when never
+        observed). Deltas of this across a code region attribute that
+        region's share of a timer recorded deeper in the stack — e.g.
+        the scheduler reads the engine's ``device_s`` around one chunk's
+        dispatch to get that chunk's device seconds."""
+        with self._lock:
+            t = self._timers.get(name)
+            return t[0] if t else default
+
     def snapshot(self):
         """Raw state: ``{"counters": {...}, "timers": {name: {"total_s",
-        "count"}}, "gauges": {...}}``. Values are plain JSON types."""
+        "count"}}, "gauges": {...}, "hists": {name: {"buckets",
+        "counts", "sum", "count"}}}``. Values are plain JSON types;
+        ``counts`` are per-bucket (non-cumulative) with one trailing
+        overflow bucket (the Prometheus ``+Inf`` bucket)."""
         with self._lock:
             return {
                 "counters": dict(self._counters),
@@ -106,6 +166,15 @@ class MetricsRegistry:
                     for k, v in self._timers.items()
                 },
                 "gauges": dict(self._gauges),
+                "hists": {
+                    k: {
+                        "buckets": list(HIST_BUCKETS.get(k, TIME_BUCKETS)),
+                        "counts": list(v[0]),
+                        "sum": round(v[1], 6),
+                        "count": v[2],
+                    }
+                    for k, v in self._hists.items()
+                },
             }
 
     def summary(self):
@@ -140,6 +209,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._timers.clear()
             self._gauges.clear()
+            self._hists.clear()
 
 
 _default = MetricsRegistry()

@@ -38,6 +38,10 @@ import random
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from ..obs import prom
+from ..obs.chrome import export_run_trace
+from ..obs.schema import chunk_timing
+from ..obs.trace import span
 from .faults import FaultAbort, FaultPlan
 from .liveness import is_timeout_error
 from .metrics import get_metrics
@@ -321,12 +325,18 @@ class SurveyScheduler:
 
     def _stage(self, loaders, fnames, chunk_id):
         """Host half of one chunk: load + DQ-scan/repair + detrend +
-        wire-prep. Returns (tslist, items, digest) — tslist is retained
-        so a corrupted chunk can be re-prepared without re-reading
-        files. Files skipped by the ingest policy or quarantined by the
-        data-quality scan load as None and are dropped here (the
-        journal's chunk record carries their DQ summary)."""
-        with self.metrics.timer("chunk_prep_s"):
+        wire-prep. Returns (tslist, items, digest, prep_s) — tslist is
+        retained so a corrupted chunk can be re-prepared without
+        re-reading files; prep_s feeds the chunk's journaled timing
+        block (this runs on the staging thread, OVERLAPPED with the
+        previous chunk's device work, so it is reported but excluded
+        from the serial wall-clock sum). Files skipped by the ingest
+        policy or quarantined by the data-quality scan load as None and
+        are dropped here (the journal's chunk record carries their DQ
+        summary)."""
+        t0 = time.perf_counter()
+        with self.metrics.timer("chunk_prep_s"), \
+                span("stage", chunk=chunk_id):
             tslist = [
                 ts for ts in loaders.map(
                     lambda f: self.searcher.load_prepared(
@@ -336,7 +346,8 @@ class SurveyScheduler:
                 if ts is not None
             ]
             items = self.searcher._prepare_chunk(tslist)
-        return tslist, items, _wire_digest(items)
+        return (tslist, items, _wire_digest(items),
+                time.perf_counter() - t0)
 
     # -- dispatch -----------------------------------------------------------
 
@@ -345,7 +356,16 @@ class SurveyScheduler:
         (The fault plan's dispatch trigger fires in run_with_retry;
         hang/straggle faults fire here, inside the watchdog deadline.)
         An attempt the watchdog already abandoned aborts at the
-        deadline check instead of shipping real device work."""
+        deadline check instead of shipping real device work.
+
+        Returns ``(peaks, parts)`` where ``parts`` holds the attempt's
+        serial phase seconds (ship/queue/collect wall time measured
+        here; device seconds and wire bytes read as deltas of the
+        engine's own metrics, so the scheduler never re-times what the
+        engine already records). The chunk-tagged spans around each
+        phase are what the engine-level prep/wire/dispatch/device spans
+        nest under — span attribute inheritance is how they pick up the
+        chunk id."""
         self.faults.in_flight(chunk_id)
         if deadline is not None:
             deadline.check()
@@ -354,15 +374,42 @@ class SurveyScheduler:
                 f"chunk {chunk_id}: prepared wire buffer digest mismatch "
                 "(corrupted transfer buffer)"
             )
-        shipped = self.searcher._ship_chunk(items)
-        queued = self.searcher._queue_chunk(shipped)
-        return self.searcher._collect_chunk(queued)
+        m = self.metrics
+        dev0 = m.timer_total("device_s")
+        wb0 = m.counter("wire_bytes")
+        t0 = time.perf_counter()
+        with span("ship", chunk=chunk_id):
+            shipped = self.searcher._ship_chunk(items)
+        t1 = time.perf_counter()
+        with span("queue", chunk=chunk_id):
+            queued = self.searcher._queue_chunk(shipped)
+        t2 = time.perf_counter()
+        with span("collect", chunk=chunk_id):
+            peaks = self.searcher._collect_chunk(queued)
+        t3 = time.perf_counter()
+        collect_s = t3 - t2
+        # The device wait happens INSIDE collect, so its delta can
+        # never legitimately exceed collect_s; clamping bounds the
+        # pollution from a watchdog-abandoned attempt's sacrificial
+        # thread recording into the registry while this attempt's
+        # delta window is open (wire_bytes keeps the same residual
+        # imprecision — it only feeds the display-grade wire_MBps).
+        parts = {
+            "wire_s": t1 - t0,
+            "queue_s": t2 - t1,
+            "collect_s": collect_s,
+            "device_s": min(m.timer_total("device_s") - dev0, collect_s),
+            "wire_bytes": int(m.counter("wire_bytes") - wb0),
+        }
+        return peaks, parts
 
     def _dispatch_with_retry(self, chunk_id, tslist, items, digest):
         """One chunk's device dispatch under :func:`run_with_retry`,
         with a recovery hook that re-prepares the chunk from the
         retained host data when the prepared buffer was corrupted.
-        Returns (peaks, attempts, digest)."""
+        Returns (peaks, parts, attempts, digest) — ``parts`` is the
+        phase decomposition of the SUCCESSFUL attempt (failed attempts'
+        time lands in the chunk's ``host_s`` remainder)."""
         state = {"items": items, "digest": digest}
 
         def work():
@@ -385,11 +432,11 @@ class SurveyScheduler:
                     state["items"] = self.searcher._prepare_chunk(tslist)
                 state["digest"] = _wire_digest(state["items"])
 
-        peaks, attempts = run_with_retry(
+        (peaks, parts), attempts = run_with_retry(
             work, chunk_id, self.retry, self.faults, self.metrics,
             on_retry=recover,
         )
-        return peaks, attempts, state["digest"]
+        return peaks, parts, attempts, state["digest"]
 
     # -- parking ------------------------------------------------------------
 
@@ -436,6 +483,10 @@ class SurveyScheduler:
 
         pending = [i for i in range(len(self.chunks)) if i not in done]
         peaks_by_chunk = dict(done)
+        # Exposition hooks: a scraper polls the RUNNING survey via the
+        # optional localhost endpoint (RIPTIDE_PROM_PORT); both calls
+        # are single flag reads when the operator left them off.
+        prom.maybe_serve(self.metrics)
         with ThreadPoolExecutor(max_workers=1) as stager, \
                 ThreadPoolExecutor(max_workers=self.searcher.io_threads) \
                 as loaders:
@@ -444,7 +495,7 @@ class SurveyScheduler:
                       if pending else None)
             for k, cid in enumerate(pending):
                 self.metrics.set_gauge("queue_depth", len(pending) - k)
-                tslist, items, digest = staged.result()
+                tslist, items, digest, prep_s = staged.result()
                 if k + 1 < len(pending):
                     staged = stager.submit(
                         self._stage, loaders, self.chunks[pending[k + 1]],
@@ -458,9 +509,9 @@ class SurveyScheduler:
                 t0 = time.perf_counter()
                 self.faults.corrupt_wire(cid, items)
                 try:
-                    peaks, attempts, digest = self._dispatch_with_retry(
-                        cid, tslist, items, digest
-                    )
+                    peaks, parts, attempts, digest = \
+                        self._dispatch_with_retry(cid, tslist, items,
+                                                  digest)
                 except (KeyboardInterrupt, SystemExit, FaultAbort):
                     raise
                 except Exception as err:
@@ -481,17 +532,23 @@ class SurveyScheduler:
                     dq = {}
                     if hasattr(self.searcher, "chunk_dq_summary"):
                         dq = self.searcher.chunk_dq_summary(self.chunks[cid])
-                    self.journal.record_chunk(
-                        cid, self.chunks[cid],
-                        [float(ts.metadata["dm"] or 0.0) for ts in tslist],
-                        peaks, wire_digest=digest,
-                        timings={"chunk_s": round(chunk_s, 6)},
-                        attempts=attempts, dq=dq,
-                    )
+                    timing = chunk_timing(chunk_s, prep_s=prep_s, **parts)
+                    with span("journal", chunk=cid):
+                        self.journal.record_chunk(
+                            cid, self.chunks[cid],
+                            [float(ts.metadata["dm"] or 0.0)
+                             for ts in tslist],
+                            peaks, wire_digest=digest,
+                            timings=timing, attempts=attempts, dq=dq,
+                        )
                 log.debug("chunk %d/%d done: %d peaks, %d attempt(s)",
                           cid + 1, len(self.chunks), len(peaks), attempts)
         self.metrics.set_gauge("queue_depth", 0)
         if self.journal is not None:
             self.journal.record_metrics(self.metrics.summary())
+            # One Perfetto-loadable trace file per run, next to the
+            # journal (no-op while tracing is disabled).
+            export_run_trace(self.journal.directory)
+        prom.maybe_write_textfile(self.metrics)
         return [p for cid in sorted(peaks_by_chunk)
                 for p in peaks_by_chunk[cid]]

@@ -133,6 +133,39 @@ _ALL = [
         since="PR 5 (0.7.0)",
     ),
     EnvFlag(
+        "RIPTIDE_TRACE", "bool", False,
+        "Enable the span tracer for the whole process at import time: "
+        "host-side survey phases (prep/wire/queue/device/collect, "
+        "per-dispatch kinds) record into a bounded in-memory ring, "
+        "exportable as a Perfetto-loadable Chrome trace "
+        "(riptide_tpu.obs). Off by default; the disabled path is a "
+        "single None check per span.",
+        since="PR 8 (0.8.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_TRACE_RING", "int", 65536,
+        "Span-ring capacity of the tracer (completed spans retained "
+        "for export). The ring is bounded: a long survey drops the "
+        "oldest spans and the export records how many "
+        "(`dropped_events`), so memory stays flat.",
+        since="PR 8 (0.8.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_PROM_PORT", "int", 0,
+        "Serve Prometheus text-format metrics from the process-wide "
+        "registry at http://127.0.0.1:<port>/metrics on a daemon "
+        "thread (stdlib-only; started by survey runs via "
+        "riptide_tpu.obs.prom.maybe_serve). 0 disables the endpoint.",
+        since="PR 8 (0.8.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_PROM_TEXTFILE", "str", None,
+        "Path of a Prometheus textfile (node_exporter textfile-"
+        "collector format) the survey layers write the metrics "
+        "registry to at the end of each run; unset disables.",
+        since="PR 8 (0.8.0)",
+    ),
+    EnvFlag(
         "RIPTIDE_BENCH_BUDGET", "float", 1380.0,
         "Total process wall-time budget (seconds) bench.py runs "
         "against: the first timed pass always emits a JSON line, "

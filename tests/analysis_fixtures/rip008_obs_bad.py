@@ -1,0 +1,32 @@
+"""BAD fixture for RIP008 (obs discipline): bare span() calls, tracing
+inside a jit body and a Pallas kernel closure, and an unregistered
+observability flag."""
+import jax
+import jax.experimental.pallas as pl
+
+from riptide_tpu.obs.trace import span
+from riptide_tpu.utils import envflags
+
+
+def leaky(x):
+    s = span("phase", chunk=1)  # BAD: span() not used as a context manager
+    s.__enter__()
+    return x
+
+
+@jax.jit
+def traced(x):
+    with span("inside_jit"):  # BAD: tracing call inside a jit body
+        return x * 2
+
+
+def _kernel(x_ref, o_ref):
+    with span("inside_kernel"):  # BAD: tracing inside a kernel closure
+        o_ref[...] = x_ref[...]
+
+
+def launch(x):
+    return pl.pallas_call(_kernel, out_shape=x, grid=(1,))(x)
+
+
+RING = envflags.get("RIPTIDE_TRACE_BOGUS")  # BAD: unregistered flag

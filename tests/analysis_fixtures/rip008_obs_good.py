@@ -1,0 +1,30 @@
+"""GOOD fixture for RIP008 (obs discipline): spans only as context
+managers on host-side phases, jit bodies and kernel closures free of
+tracing, and only registered observability flags."""
+import jax
+import jax.experimental.pallas as pl
+
+from riptide_tpu.obs.trace import span
+from riptide_tpu.utils import envflags
+
+
+def staged(x):
+    with span("stage", chunk=0) as s:
+        s.set(files=3)
+        return x + 1
+
+
+@jax.jit
+def traced(x):
+    return x * 2
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def launch(x):
+    return pl.pallas_call(_kernel, out_shape=x, grid=(1,))(x)
+
+
+TRACING = envflags.get("RIPTIDE_TRACE")

@@ -1,0 +1,510 @@
+"""
+Observability subsystem tests (riptide_tpu/obs/): span tracer
+thread-safety and ring bounds, the disabled-mode zero-allocation fast
+path, Chrome trace-event export validity and multi-process merge,
+Prometheus text-format exposition (and its histogram/counter
+consistency), the shared timing-key schema, and the journal `timing`
+block through a real kill-and-resume survey.
+"""
+import gc
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from riptide_tpu.obs import prom
+from riptide_tpu.obs.chrome import (
+    export_run_trace, merge_chrome_traces, write_chrome_trace,
+)
+from riptide_tpu.obs.schema import (
+    CHUNK_TIMING_KEYS, DECOMPOSITION_KEYS, chunk_timing, classify_bound,
+    decomposition,
+)
+from riptide_tpu.obs.trace import NULL_SPAN, Tracer, set_tracer, span
+from riptide_tpu.survey.metrics import MetricsRegistry, get_metrics
+
+from synth import generate_data_presto
+
+
+@pytest.fixture
+def tracer():
+    """Install a fresh tracer for the test; restore the previous (in
+    the default suite: no) tracer afterwards, so the disabled fast path
+    stays the suite-wide norm."""
+    tr = Tracer(capacity=4096)
+    prev = set_tracer(tr)
+    yield tr
+    set_tracer(prev)
+
+
+# ------------------------------------------------------------- tracer
+
+def test_span_records_nests_and_inherits_chunk(tracer):
+    with span("stage", chunk=7):
+        with span("prep", mode="float32"):
+            pass
+    events = tracer.events()
+    assert [e[0] for e in events] == ["prep", "stage"]  # completion order
+    prep, stage = events
+    assert prep[4]["chunk"] == 7          # inherited from parent span
+    assert prep[4]["mode"] == "float32"
+    assert stage[4] == {"chunk": 7}
+    assert all(e[1] >= 0.0 and e[2] >= 0.0 for e in events)
+
+
+def test_span_set_and_error_attrs(tracer):
+    with pytest.raises(ValueError):
+        with span("work", chunk=1) as s:
+            s.set(files=3)
+            raise ValueError("boom")
+    (name, _, _, _, attrs), = tracer.events()
+    assert name == "work"
+    assert attrs["files"] == 3
+    assert attrs["error"] == "ValueError"
+
+
+def test_tracer_thread_safety():
+    tr = Tracer(capacity=10_000)
+    prev = set_tracer(tr)
+    try:
+        def worker(k):
+            for i in range(200):
+                with span("phase", worker=k):
+                    pass
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        set_tracer(prev)
+    assert tr.recorded == 8 * 200
+    assert tr.dropped_events == 0
+    events = tr.events()
+    assert len(events) == 8 * 200
+    # No cross-thread interleaving corrupted the record: every worker's
+    # 200 spans all arrived, each on a single thread lane. (Thread ids
+    # may be REUSED across joined threads, so lanes can coincide; what
+    # must hold is one lane per worker and a complete count.)
+    by_worker = {}
+    for _, _, _, tid, attrs in events:
+        by_worker.setdefault(attrs["worker"], []).append(tid)
+    assert set(by_worker) == set(range(8))
+    for tids in by_worker.values():
+        assert len(tids) == 200
+        assert len(set(tids)) == 1
+
+
+def test_ring_buffer_bounded():
+    tr = Tracer(capacity=16)
+    prev = set_tracer(tr)
+    try:
+        for i in range(100):
+            with span("s", i=i):
+                pass
+    finally:
+        set_tracer(prev)
+    events = tr.events()
+    assert len(events) == 16
+    assert tr.recorded == 100
+    assert tr.dropped_events == 84
+    # The ring keeps the NEWEST spans.
+    assert [e[4]["i"] for e in events] == list(range(84, 100))
+
+
+def test_disabled_span_fast_path():
+    """With no tracer installed, span() must return the shared no-op
+    singleton and retain NOTHING: zero net allocations across 200k
+    disabled spans (the 'no measurable overhead without --trace'
+    acceptance assertion)."""
+    from riptide_tpu.obs import trace as trace_mod
+
+    assert trace_mod.get_tracer() is None, \
+        "suite must run with tracing disabled by default"
+    assert span("x") is NULL_SPAN
+    assert span("x", chunk=1) is NULL_SPAN
+    assert NULL_SPAN.set(a=1) is NULL_SPAN
+    with span("warmup", chunk=0):
+        pass
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for i in range(200_000):
+        with span("phase", chunk=1, kind="fused"):
+            pass
+    gc.collect()
+    after = sys.getallocatedblocks()
+    # Interpreter noise allowance only — any per-span retention would
+    # show up as >= 200k blocks.
+    assert after - before < 1000, f"retained {after - before} blocks"
+
+
+# ------------------------------------------------------- chrome export
+
+def test_chrome_trace_valid_and_monotone_per_lane(tmp_path, tracer):
+    def burst(tag):
+        for i in range(5):
+            with span("chunkwork", chunk=i, tag=tag):
+                with span("inner"):
+                    pass
+
+    t = threading.Thread(target=burst, args=("bg",), name="bg-thread")
+    t.start()
+    t.join()
+    burst("main")
+
+    path = str(tmp_path / "trace.json")
+    assert write_chrome_trace(path, tracer) == path
+    with open(path) as fobj:
+        doc = json.load(fobj)
+    events = doc["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    ms = [e for e in events if e["ph"] == "M"]
+    assert len(xs) == 20
+    assert any(m["name"] == "process_name" for m in ms)
+    assert any(m["name"] == "thread_name"
+               and m["args"]["name"] == "bg-thread" for m in ms)
+    for e in xs:
+        assert set(e) >= {"name", "cat", "ph", "pid", "tid", "ts", "dur",
+                          "args"}
+        assert e["ts"] >= 0.0 and e["dur"] >= 0.0
+    # Events are recorded at span COMPLETION on a monotonic clock, so
+    # within each lane (tid) the end timestamps never go backwards.
+    by_tid = {}
+    for e in xs:
+        by_tid.setdefault(e["tid"], []).append(e["ts"] + e["dur"])
+    assert len(by_tid) == 2
+    for ends in by_tid.values():
+        assert ends == sorted(ends)
+    assert doc["otherData"]["recorded"] == 20
+    assert doc["otherData"]["dropped_events"] == 0
+    assert doc["otherData"]["wall_t0_unix_s"] == tracer.wall_t0
+
+
+def test_chrome_merge_keeps_process_lanes(tmp_path):
+    paths = []
+    for pid in (0, 1):
+        tr = Tracer(capacity=64)
+        prev = set_tracer(tr)
+        try:
+            with span("work", p=pid):
+                pass
+        finally:
+            set_tracer(prev)
+        # Pretend process 1 started 2 s later in absolute time.
+        tr.wall_t0 = 1000.0 + 2.0 * pid
+        path = str(tmp_path / f"trace_{pid:04d}.json")
+        write_chrome_trace(path, tr, pid=pid)
+        paths.append(path)
+
+    out = str(tmp_path / "trace.json")
+    merge_chrome_traces(paths, out)
+    with open(out) as fobj:
+        doc = json.load(fobj)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {0, 1}
+    # Lane 1 is re-anchored +2 s relative to the earliest process.
+    ts = {e["pid"]: e["ts"] for e in xs}
+    assert ts[1] - ts[0] >= 2e6 - 1e3  # microseconds
+    assert doc["otherData"]["wall_t0_unix_s"] == 1000.0
+
+
+def test_export_run_trace(tmp_path, tracer):
+    with span("w"):
+        pass
+    # Multihost: each process writes its own lane; process 0 merges.
+    assert export_run_trace(str(tmp_path), 1, 2).endswith(
+        "trace_0001.json")
+    assert not os.path.exists(tmp_path / "trace.json")
+    export_run_trace(str(tmp_path), 0, 2)
+    assert (tmp_path / "trace_0000.json").exists()
+    with open(tmp_path / "trace.json") as fobj:
+        merged = json.load(fobj)
+    assert {e["pid"] for e in merged["traceEvents"]
+            if e["ph"] == "X"} == {0, 1}
+    # Disabled tracing: export is a no-op.
+    prev = set_tracer(None)
+    try:
+        assert export_run_trace(str(tmp_path)) is None
+    finally:
+        set_tracer(prev)
+
+
+# ------------------------------------------------------------ prometheus
+
+def _parse_prom(text):
+    """{name: {labels-or-'': value}} + per-name TYPE, permissively
+    parsing the text format."""
+    values, types = {}, {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind
+        elif line and not line.startswith("#"):
+            lhs, val = line.rsplit(None, 1)
+            name, _, labels = lhs.partition("{")
+            values.setdefault(name, {})[labels.rstrip("}")] = float(val)
+    return values, types
+
+
+def test_prom_render_counters_gauges_histograms():
+    m = MetricsRegistry()
+    m.add("chunks_done", 3)
+    m.set_gauge("queue_depth", 2)
+    m.observe("chunk_s", 0.5)
+    m.observe("chunk_s", 3.0)
+    m.observe_hist("wire_MBps", 42.0)
+    text = prom.render(m)
+    values, types = _parse_prom(text)
+
+    assert values["riptide_chunks_done_total"][""] == 3
+    assert types["riptide_chunks_done_total"] == "counter"
+    assert values["riptide_queue_depth"][""] == 2
+    assert types["riptide_queue_depth"] == "gauge"
+
+    assert types["riptide_chunk_seconds"] == "histogram"
+    buckets = values["riptide_chunk_seconds_bucket"]
+    assert values["riptide_chunk_seconds_count"][""] == 2
+    assert values["riptide_chunk_seconds_sum"][""] == pytest.approx(3.5)
+    assert buckets['le="+Inf"'] == 2
+    # Cumulative bucket counts are monotone non-decreasing.
+    ordered = [buckets[k] for k in buckets if k != 'le="+Inf"']
+    assert ordered == sorted(ordered)
+    # 0.5 s lands at le=1.0; 3.0 s at le=4.0.
+    assert buckets['le="1"'] == 1
+    assert buckets['le="4"'] == 2
+
+    # Rate histogram uses the MB/s ladder, not the seconds ladder.
+    assert buckets != values["riptide_wire_MBps_bucket"]
+    assert values["riptide_wire_MBps_bucket"]['le="64"'] == 1
+    # Every line of the page parses, and HELP precedes each family.
+    assert text.count("# HELP") == text.count("# TYPE")
+
+
+def test_prom_histogram_sum_equals_timer_total():
+    """A histogram's _sum is the same accumulator the summary exposes —
+    the 'histograms sum to the run's counter totals' acceptance
+    property."""
+    m = MetricsRegistry()
+    for sec in (0.1, 0.2, 1.7):
+        m.observe("device_s", sec)
+    snap = m.snapshot()
+    assert snap["hists"]["device_s"]["sum"] == pytest.approx(
+        snap["timers"]["device_s"]["total_s"])
+    assert snap["hists"]["device_s"]["count"] == \
+        snap["timers"]["device_s"]["count"]
+    values, _ = _parse_prom(prom.render(m))
+    assert values["riptide_device_seconds_sum"][""] == pytest.approx(2.0)
+    assert values["riptide_device_seconds_count"][""] == 3
+
+
+def test_write_prom_textfile(tmp_path, monkeypatch):
+    m = MetricsRegistry()
+    m.add("chunks_done")
+    path = str(tmp_path / "riptide.prom")
+    assert prom.write_prom(path, m) == path
+    with open(path) as fobj:
+        assert fobj.read() == prom.render(m)
+    # maybe_write_textfile honours the env flag (parsed at call time).
+    monkeypatch.delenv("RIPTIDE_PROM_TEXTFILE", raising=False)
+    assert prom.maybe_write_textfile(m) is None
+    path2 = str(tmp_path / "auto.prom")
+    monkeypatch.setenv("RIPTIDE_PROM_TEXTFILE", path2)
+    assert prom.maybe_write_textfile(m) == path2
+    assert os.path.exists(path2)
+
+
+def test_prom_http_endpoint():
+    m = MetricsRegistry()
+    m.add("chunks_done", 5)
+    server = prom.serve(0, registry=m)
+    try:
+        url = f"http://127.0.0.1:{server.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert "riptide_chunks_done_total 5" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/nope", timeout=5.0)
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------- schema
+
+def test_chunk_timing_sums_to_wall_clock():
+    t = chunk_timing(2.0, prep_s=0.4, wire_s=0.5, queue_s=0.1,
+                     device_s=1.0, collect_s=1.1, wire_bytes=50_000_000)
+    assert set(t) == set(CHUNK_TIMING_KEYS)
+    # The serial phases (prep overlaps and is excluded) reconstruct the
+    # measured wall-clock exactly — the journal's 5% acceptance bound
+    # holds by construction.
+    assert t["wire_s"] + t["queue_s"] + t["collect_s"] + t["host_s"] == \
+        pytest.approx(t["chunk_s"], rel=1e-6)
+    assert t["bound"] == "device"
+    assert t["wire_MBps"] == pytest.approx(100.0)
+    # Timer skew cannot push host_s negative.
+    t2 = chunk_timing(1.0, wire_s=0.7, queue_s=0.2, collect_s=0.3)
+    assert t2["host_s"] == 0.0
+
+
+def test_classify_bound():
+    assert classify_bound(8.0, 1.0) == "tunnel"
+    assert classify_bound(0.9, 1.0) == "tunnel"  # >= 0.8 ratio
+    assert classify_bound(0.1, 1.0) == "device"
+    # No device measurement: a ratio against zero must not scream
+    # "tunnel".
+    assert classify_bound(0.0, 0.0) == "unknown"
+    assert classify_bound(0.5, 0.0) == "unknown"
+
+
+def test_decomposition_keys_shared_with_bench_and_stime():
+    s = {"prep_s": 1.0, "wire_s": 2.0, "device_s": 3.0, "wire_MBps": 25.0}
+    d = decomposition(s, nchunks=4, elapsed=10.0)
+    assert set(d) == set(DECOMPOSITION_KEYS)
+    assert d["chunk_s"] == 2.5
+    assert d["wire_MBps"] == 25.0
+
+
+# ------------------------------------- journal timing + kill-and-resume
+
+TOBS, TSAMP, PERIOD = 16.0, 1e-3, 0.5
+
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+
+def _searcher():
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+
+    return BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                         SEARCH_CONF, fmt="presto", io_threads=1)
+
+
+def _two_trials(tmp_path):
+    f1 = generate_data_presto(str(tmp_path), "a_DM0.00", tobs=TOBS,
+                              tsamp=TSAMP, period=PERIOD, dm=0.0)
+    f2 = generate_data_presto(str(tmp_path), "b_DM5.00", tobs=TOBS,
+                              tsamp=TSAMP, period=PERIOD, dm=5.0)
+    return f1, f2
+
+
+def test_survey_timing_block_spans_and_resume(tmp_path, tracer,
+                                              monkeypatch):
+    """The acceptance path on the tiny CPU config: a traced survey run
+    journals a per-chunk `timing` decomposition that sums to the
+    chunk's wall-clock, exports a Perfetto-loadable trace with
+    prep/wire/dispatch/collect spans per chunk next to the journal,
+    writes a Prometheus textfile whose histogram counts match the run's
+    counters — and the timing/UTC fields survive kill-and-resume."""
+    from riptide_tpu.survey.faults import FaultAbort, FaultPlan
+    from riptide_tpu.survey.journal import SurveyJournal
+    from riptide_tpu.survey.scheduler import SurveyScheduler
+
+    f1, f2 = _two_trials(tmp_path)
+    jdir = str(tmp_path / "j")
+    promfile = str(tmp_path / "riptide.prom")
+    monkeypatch.setenv("RIPTIDE_PROM_TEXTFILE", promfile)
+    get_metrics().reset()
+
+    with pytest.raises(FaultAbort):
+        SurveyScheduler(
+            _searcher(), [[f1], [f2]], journal=SurveyJournal(jdir),
+            faults=FaultPlan.parse("abort:1"),
+        ).run()
+
+    j = SurveyJournal(jdir)
+    done = j.completed_chunks()
+    assert sorted(done) == [0]
+    rec = done[0][0]
+    # UTC wall-clock stamp (ISO-8601, Z suffix) on the chunk record.
+    assert rec["utc"].endswith("Z") and "T" in rec["utc"]
+    t = rec["timings"]
+    assert set(CHUNK_TIMING_KEYS) - {"wire_MBps"} <= set(t)
+    assert t["wire_s"] + t["queue_s"] + t["collect_s"] + t["host_s"] == \
+        pytest.approx(t["chunk_s"], rel=1e-6, abs=2e-6)
+    assert t["bound"] in ("tunnel", "device")
+
+    # The aborted run exported nothing (the kill pre-empted the
+    # end-of-run hooks) — the resume run must complete the survey and
+    # leave the trace + textfile behind.
+    assert not os.path.exists(os.path.join(jdir, "trace.json"))
+
+    get_metrics().reset()
+    peaks = SurveyScheduler(
+        _searcher(), [[f1], [f2]], journal=SurveyJournal(jdir),
+        resume=True,
+    ).run()
+    assert peaks
+    done = SurveyJournal(jdir).completed_chunks()
+    assert sorted(done) == [0, 1]
+    # The replayed chunk keeps its original timing block verbatim.
+    assert done[0][0]["timings"] == t
+    assert "utc" in done[1][0]
+
+    # Chrome trace next to the journal: survey phases as spans, chunk
+    # attribution on the engine-level spans (inherited from the
+    # scheduler's chunk-tagged spans). The shared tracer ring still
+    # holds the killed run's chunk-0 spans alongside the resume run's
+    # chunk-1 spans.
+    with open(os.path.join(jdir, "trace.json")) as fobj:
+        doc = json.load(fobj)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in xs}
+    assert {"stage", "ship", "queue", "collect", "journal",
+            "prep", "wire", "device", "dispatch"} <= names
+    for nm in ("prep", "wire", "dispatch", "collect", "device"):
+        chunks = {e["args"].get("chunk") for e in xs if e["name"] == nm}
+        assert chunks and chunks <= {0, 1}, (nm, chunks)
+    assert 1 in {e["args"].get("chunk") for e in xs
+                 if e["name"] == "dispatch"}
+
+    # Prometheus textfile (end-of-run hook): histogram counts equal the
+    # resume run's counter totals.
+    with open(promfile) as fobj:
+        values, _ = _parse_prom(fobj.read())
+    assert values["riptide_chunk_seconds_count"][""] == \
+        values["riptide_chunks_done_total"][""] == 1
+    assert values["riptide_chunks_skipped_total"][""] == 1
+
+
+def test_resume_tolerates_records_without_new_fields(tmp_path):
+    """A journal written before the timing/utc fields existed (or a
+    heartbeat sidecar without them) must still resume / tail-read."""
+    from riptide_tpu.survey.journal import (
+        SurveyJournal, _append_line,
+    )
+
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("old", 1)
+    # Old-format chunk record: no utc, no timings.
+    _append_line(j.journal_path, {
+        "kind": "chunk", "chunk_id": 0, "files": ["a.inf"], "dms": [0.0],
+        "wire_digest": None, "peaks_offset": 0, "peaks_count": 0,
+        "attempts": 1,
+    })
+    done = SurveyJournal(tmp_path / "j").completed_chunks()
+    assert sorted(done) == [0]
+    assert done[0][0].get("utc") is None
+
+    # Old-format heartbeat line: ts only.
+    _append_line(os.path.join(j.directory, "heartbeat_0003.jsonl"),
+                 {"process": 3, "ts": 123.0})
+    assert j.read_heartbeats() == {3: 123.0}
+    # New-format beats carry a UTC stamp alongside the monotonic ts.
+    j.heartbeat(4, ts=5.0)
+    assert j.read_heartbeats()[4] == 5.0
+    import json as _json
+
+    with open(os.path.join(j.directory, "heartbeat_0004.jsonl")) as fobj:
+        rec = _json.loads(fobj.readline())
+    assert rec["utc"].endswith("Z")

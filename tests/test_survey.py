@@ -52,7 +52,8 @@ def test_metrics_counters_timers_gauges():
     assert snap["timers"]["prep_s"]["count"] == 1
     assert snap["gauges"]["queue_depth"] == 4
     m.reset()
-    assert m.snapshot() == {"counters": {}, "timers": {}, "gauges": {}}
+    assert m.snapshot() == {"counters": {}, "timers": {}, "gauges": {},
+                            "hists": {}}
 
 
 def test_metrics_summary_derives_wire_rate():

@@ -147,15 +147,18 @@ def main(D=32, CHUNKS=4):
         dt = time.perf_counter() - tstart
         print(f"steady: {CHUNKS} chunks in {dt:.2f}s = "
               f"{D*CHUNKS/dt:.2f} trials/s", flush=True)
+        # The decomposition keys come from the ONE timing schema shared
+        # with bench.py's best line and the survey journal
+        # (riptide_tpu.obs.schema), so all three surfaces stay
+        # key-identical for log parsers.
+        from riptide_tpu.obs.schema import decomposition
+
         s = metrics.summary()
         block = {
             "metric": "stime_decomposition",
             "trials_per_sec": round(D * CHUNKS / dt, 3),
-            "device_s": round(s.get("device_s", 0.0), 3),
-            "prep_s": round(s.get("prep_s", 0.0), 3),
-            "wire_MBps": s.get("wire_MBps"),
-            "chunk_s": round(dt / max(CHUNKS, 1), 3),
         }
+        block.update(decomposition(s, CHUNKS, dt))
         block.update({k: v for k, v in s.items()
                       if k.startswith("dispatch_")})
         print(json.dumps(block), flush=True)

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""
+trace-demo: produce a Perfetto-loadable trace from a tiny CPU survey.
+
+Synthesizes two small dedispersed time series, runs them through the
+checkpointed survey scheduler with the span tracer enabled, and leaves
+in the output directory (default /tmp/riptide_trace_demo, or argv[1]):
+
+* ``j/trace.json``      — Chrome trace-event JSON: open in Perfetto
+  (https://ui.perfetto.dev) or chrome://tracing; one flame lane per
+  host thread with stage/ship/queue/collect/journal spans per chunk
+  and the engine's prep/wire/dispatch/device spans nested inside;
+* ``j/journal.jsonl``   — the survey journal, each chunk record
+  carrying its ``timing`` phase decomposition and UTC stamp;
+* ``riptide.prom``      — Prometheus text-format exposition of the
+  run's metrics registry (counters, gauges, latency histograms).
+
+The script also sanity-checks what it wrote (trace loads as JSON and
+holds the expected span names; the timing block sums to the chunk
+wall-clock; the histogram counts match the counters) so ``make
+trace-demo`` doubles as a smoke test of the whole obs path.
+"""
+import json
+import os
+import shutil
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+TOBS, TSAMP, PERIOD = 16.0, 1e-3, 0.5
+
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+
+def main(outdir="/tmp/riptide_trace_demo"):
+    from synth import generate_data_presto
+
+    from riptide_tpu.obs import prom, trace
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+    from riptide_tpu.survey.journal import SurveyJournal
+    from riptide_tpu.survey.metrics import get_metrics
+    from riptide_tpu.survey.scheduler import SurveyScheduler
+
+    shutil.rmtree(outdir, ignore_errors=True)
+    os.makedirs(outdir)
+    files = [
+        generate_data_presto(outdir, f"demo_DM{dm:.2f}", tobs=TOBS,
+                             tsamp=TSAMP, period=PERIOD, dm=dm,
+                             amplitude=25.0)
+        for dm in (0.0, 5.0)
+    ]
+
+    trace.enable()
+    get_metrics().reset()
+    jdir = os.path.join(outdir, "j")
+    searcher = BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                             SEARCH_CONF, fmt="presto", io_threads=1)
+    peaks = SurveyScheduler(searcher, [[f] for f in files],
+                            journal=SurveyJournal(jdir)).run()
+    promfile = os.path.join(outdir, "riptide.prom")
+    prom.write_prom(promfile)
+
+    # -- verify what we just wrote ------------------------------------
+    trace_path = os.path.join(jdir, "trace.json")
+    with open(trace_path) as fobj:
+        doc = json.load(fobj)
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in spans}
+    missing = {"stage", "ship", "queue", "collect", "journal",
+               "prep", "wire", "dispatch", "device"} - names
+    assert not missing, f"trace is missing spans: {missing}"
+
+    with open(os.path.join(jdir, "journal.jsonl")) as fobj:
+        chunks = [json.loads(l) for l in fobj
+                  if '"kind":"chunk"' in l]
+    for rec in chunks:
+        t = rec["timings"]
+        serial = t["wire_s"] + t["queue_s"] + t["collect_s"] + t["host_s"]
+        assert abs(serial - t["chunk_s"]) <= 0.05 * max(t["chunk_s"], 1e-9)
+
+    with open(promfile) as fobj:
+        page = fobj.read()
+    assert "riptide_chunk_seconds_bucket" in page
+    assert f"riptide_chunks_done_total {len(chunks)}" in page
+
+    print(f"\ntrace demo OK: {len(peaks)} peaks from {len(chunks)} chunks")
+    print(f"  spans      {len(spans):5d}  ->  {trace_path}")
+    print(f"  journal            ->  {os.path.join(jdir, 'journal.jsonl')}")
+    print(f"  prometheus         ->  {promfile}")
+    print("open the trace at https://ui.perfetto.dev (or chrome://tracing)")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:2])
