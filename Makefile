@@ -3,7 +3,7 @@
 PYTHON ?= python
 
 .PHONY: install check lint native-asan sanitize tests tests-cov native \
-	bench trace-demo clean
+	bench trace-demo report-demo clean
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -73,6 +73,14 @@ bench:
 # docs/observability.md).
 trace-demo:
 	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) tools/trace_demo.py
+
+# The consumption-side counterpart of trace-demo: tiny CPU survey with
+# the perf ledger + live /status//healthz endpoint on, then verifies
+# the rreport phase table sums within 5%, the ledger row, both
+# --compare exit codes and the healthz 503 flip on stale heartbeats
+# (see docs/observability.md).
+report-demo:
+	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) tools/report_demo.py
 
 clean:
 	rm -rf riptide_tpu/native/_build build dist *.egg-info

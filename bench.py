@@ -201,6 +201,20 @@ def _pipeline_pass(plan, tobs, nchunks, dms, batch_for, prepper, shipper):
     return elapsed
 
 
+def _ledger_row(kind, sub, nchunks, extra):
+    """Append one run row to the perf ledger (RIPTIDE_LEDGER; no-op
+    when unset). bench has no per-chunk timing records, so the
+    run-level tunnel/device classification stands in for the per-chunk
+    bound counts (the ratio is identical on totals)."""
+    from riptide_tpu.obs import ledger
+    from riptide_tpu.obs.schema import classify_bound
+
+    bound = classify_bound(sub.get("wire_s") or 0.0,
+                           sub.get("device_s") or 0.0)
+    ledger.maybe_append(kind, sub, nchunks=nchunks,
+                        bound_counts={bound: nchunks}, extra=extra)
+
+
 def _submetrics(nchunks, elapsed):
     """Machine-readable sub-metrics of the pass just timed, from the
     metrics registry the engine records into. The key set is the ONE
@@ -299,6 +313,14 @@ def bench_headline():
             # prep_s / wire_MBps / chunk_s) and the true pass count, so
             # every recorded round has the full breakdown.
             emit(best, npasses, best_sub)
+    # One perf-ledger row per bench run (no-op unless RIPTIDE_LEDGER is
+    # set): the best pass's decomposition plus the provenance that
+    # explains round-over-round deltas (git sha, flags, device, kernel
+    # cache version) — the machine-readable form of BENCH_MATRIX.
+    _ledger_row("bench", best_sub, CHUNKS,
+                {"metric": "dm_trials_per_sec_2p23_samples",
+                 "value": round(D * CHUNKS / best, 3),
+                 "passes": npasses})
 
 
 def _warm_plan(nsamp, tsamp, period_min, period_max, bins_min, bins_max,
@@ -443,8 +465,11 @@ def _survey(d, n, metric, chunk=32):
         dt = _pipeline_pass(plan, tobs, d // chunk, dms, lambda i: batch,
                             prepper, shipper)
     extra = {"total_seconds": round(dt, 2), "passes": 1}
-    extra.update(_submetrics(d // chunk, dt))
+    sub = _submetrics(d // chunk, dt)
+    extra.update(sub)
     _emit(metric, d / dt, "DM-trials/s", extra=extra)
+    _ledger_row("bench", sub, d // chunk,
+                {"metric": metric, "value": round(d / dt, 3), "passes": 1})
 
 
 def _emit(metric, value, unit, extra=None):

@@ -135,6 +135,7 @@ def _search_with_survey_hooks(args, ts):
     import os
 
     from riptide_tpu.utils import envflags
+    from riptide_tpu.survey import incidents
     from riptide_tpu.survey.faults import FaultPlan
     from riptide_tpu.survey.journal import SurveyJournal
     from riptide_tpu.survey.metrics import get_metrics
@@ -177,33 +178,54 @@ def _search_with_survey_hooks(args, ts):
     wire0 = metrics.timer_total("wire_s")
     dev0 = metrics.timer_total("device_s")
     wb0 = metrics.counter("wire_bytes")
+    # Journaled searches sink incidents (quarantine, OOM bisection,
+    # watchdog timeout) into the journal for the run's duration, like
+    # the survey scheduler does per survey.
+    prev_sink = None
+    if journal is not None:
+        incidents.clear_last()
+        prev_sink = incidents.set_sink(journal.record_incident)
     t0 = time.perf_counter()
-    peaks, attempts = run_with_retry(
-        lambda: _search_peaks(args, ts), 0, retry, faults, metrics,
-    )
+    try:
+        peaks, attempts = run_with_retry(
+            lambda: _search_peaks(args, ts), 0, retry, faults, metrics,
+        )
+    finally:
+        if journal is not None:
+            incidents.set_sink(prev_sink)
     chunk_s = time.perf_counter() - t0
     metrics.add("chunks_done")
     metrics.observe("chunk_s", chunk_s)
     if journal is not None:
+        from riptide_tpu.obs import ledger
+        from riptide_tpu.obs.report import run_decomposition_from_chunks
         from riptide_tpu.obs.schema import chunk_timing
 
         device_s = metrics.timer_total("device_s") - dev0
+        timing = chunk_timing(
+            chunk_s,
+            prep_s=metrics.timer_total("prep_s") - prep0,
+            wire_s=metrics.timer_total("wire_s") - wire0,
+            device_s=device_s,
+            # The blocking device wait happens inside the search
+            # call's collect; attribute it there rather than to the
+            # host remainder.
+            collect_s=device_s,
+            wire_bytes=int(metrics.counter("wire_bytes") - wb0),
+        )
+        journal.heartbeat(0)
         journal.record_chunk(
             0, [args.fname], [float(ts.metadata["dm"] or 0.0)], peaks,
-            timings=chunk_timing(
-                chunk_s,
-                prep_s=metrics.timer_total("prep_s") - prep0,
-                wire_s=metrics.timer_total("wire_s") - wire0,
-                device_s=device_s,
-                # The blocking device wait happens inside the search
-                # call's collect; attribute it there rather than to the
-                # host remainder.
-                collect_s=device_s,
-                wire_bytes=int(metrics.counter("wire_bytes") - wb0),
-            ),
-            attempts=attempts,
+            timings=timing, attempts=attempts,
         )
         journal.record_metrics(metrics.summary())
+        # One perf-ledger row per journaled search (no-op unless
+        # RIPTIDE_LEDGER is set) — same derivation as the scheduler's.
+        run_dec, nchunks, bound_counts = \
+            run_decomposition_from_chunks([timing])
+        ledger.maybe_append("rseek", run_dec, nchunks=nchunks,
+                            bound_counts=bound_counts,
+                            extra={"survey_id": sid})
     return peaks
 
 
@@ -254,17 +276,23 @@ def run_program(args):
     if trace.enabled():
         import os
 
-        from riptide_tpu.obs.chrome import write_chrome_trace
+        from riptide_tpu.obs.chrome import (
+            export_run_trace, write_chrome_trace,
+        )
 
         tracer = trace.get_tracer()
         if args.journal:
+            # Journal-relative export: a resumed run's fresh tracer
+            # rotates the prior attempt's trace.json to trace.json.1
+            # instead of overwriting it.
             trace_path = os.path.join(args.journal, "trace.json")
+            export_run_trace(args.journal, tracer=tracer)
         else:
             trace_path = args.fname + ".trace.json"
-        if tracer is not None:
-            write_chrome_trace(trace_path, tracer)
-            log.info(f"host span trace written to {trace_path!r} "
-                     "(load in Perfetto or chrome://tracing)")
+            if tracer is not None:
+                write_chrome_trace(trace_path, tracer)
+        log.info(f"host span trace written to {trace_path!r} "
+                 "(load in Perfetto or chrome://tracing)")
     prom.maybe_write_textfile()
     if not peaks:
         print(f"No peaks found above S/N = {args.smin:.2f}")

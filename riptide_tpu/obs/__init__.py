@@ -21,6 +21,17 @@ The observability subsystem of the survey path, in four parts:
   the metrics registry (counters/gauges/histograms), as an atomic
   textfile and an optional stdlib-only localhost HTTP endpoint
   (``RIPTIDE_PROM_PORT``).
+* :mod:`~riptide_tpu.obs.ledger` — the append-only JSONL perf ledger
+  (``RIPTIDE_LEDGER``): every bench/stime/journaled-survey run appends
+  one row (phase decomposition + git sha, envflag fingerprint, device
+  platform, ``KERNEL_CACHE_VERSION``, per-chunk bound counts) so the
+  perf trajectory is machine-readable run over run.
+* :mod:`~riptide_tpu.obs.report` — the jax-free consumption half:
+  journal/ledger/trace/prom readers, the post-run report
+  (phase-attribution table, stragglers, tunnel-rate distribution,
+  incident timeline) behind ``tools/rreport.py``, and the noise-aware
+  ledger regression verdict (``rreport --compare``). ``tools/rtop.py``
+  tail-reads the same journal artifacts for a live terminal view.
 * :mod:`~riptide_tpu.obs.schema` — the ONE timing-key schema:
   bench.py's best line, tools/stime.py's closing JSON block and the
   journal's per-chunk ``timing`` record all derive from
@@ -35,15 +46,23 @@ never inside jit-decorated bodies or Pallas kernel closures, and every
 envflags registry.
 """
 from .trace import (  # noqa: F401
-    NULL_SPAN, Span, Tracer, disable, enable, enabled, get_tracer,
-    set_tracer, span,
+    NULL_SPAN, Span, Tracer, current_span_id, disable, enable, enabled,
+    get_tracer, set_tracer, span,
 )
 from .chrome import (  # noqa: F401
     chrome_events, export_run_trace, merge_chrome_traces,
-    write_chrome_trace,
+    rotate_trace_file, write_chrome_trace,
 )
 from .prom import (  # noqa: F401
-    maybe_serve, maybe_write_textfile, render, serve, write_prom,
+    health_check, maybe_serve, maybe_write_textfile, render, serve,
+    set_status_provider, status_snapshot, write_prom,
+)
+from .ledger import (  # noqa: F401
+    append_row, make_row, maybe_append, read_rows,
+)
+from .report import (  # noqa: F401
+    build_report, compare_to_ledger, render_text,
+    run_decomposition_from_chunks,
 )
 from .schema import (  # noqa: F401
     CHUNK_TIMING_KEYS, DECOMPOSITION_KEYS, LEGACY_ALIASES, PHASES,
@@ -52,10 +71,14 @@ from .schema import (  # noqa: F401
 
 __all__ = [
     "span", "enable", "disable", "enabled", "get_tracer", "set_tracer",
-    "Span", "Tracer", "NULL_SPAN",
+    "current_span_id", "Span", "Tracer", "NULL_SPAN",
     "chrome_events", "write_chrome_trace", "merge_chrome_traces",
-    "export_run_trace",
+    "export_run_trace", "rotate_trace_file",
     "render", "write_prom", "serve", "maybe_serve", "maybe_write_textfile",
+    "set_status_provider", "status_snapshot", "health_check",
+    "make_row", "append_row", "maybe_append", "read_rows",
+    "build_report", "render_text", "compare_to_ledger",
+    "run_decomposition_from_chunks",
     "TIMING_VERSION", "PHASES", "DECOMPOSITION_KEYS", "CHUNK_TIMING_KEYS",
     "LEGACY_ALIASES", "decomposition", "chunk_timing", "classify_bound",
 ]

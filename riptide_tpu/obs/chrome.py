@@ -25,7 +25,29 @@ import json
 import os
 
 __all__ = ["chrome_events", "write_chrome_trace", "merge_chrome_traces",
-           "export_run_trace"]
+           "export_run_trace", "rotate_trace_file"]
+
+# How many prior attempts' trace files survive a rotation:
+# trace.json.1 (newest prior) .. trace.json.3 (oldest kept).
+TRACE_ROTATE_DEPTH = 3
+
+
+def rotate_trace_file(path, depth=TRACE_ROTATE_DEPTH):
+    """Shift an existing ``path`` to ``path.1`` (and ``path.1`` to
+    ``path.2``, ...), dropping anything beyond ``depth``. Called by
+    :func:`export_run_trace` before a DIFFERENT tracer (a resumed
+    attempt in a fresh process) first writes to ``path``, so a resume
+    no longer destroys the killed attempt's trace."""
+    if not os.path.exists(path):
+        return
+    oldest = f"{path}.{int(depth)}"
+    if os.path.exists(oldest):
+        os.remove(oldest)
+    for i in range(int(depth) - 1, 0, -1):
+        src = f"{path}.{i}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{i + 1}")
+    os.replace(path, f"{path}.1")
 
 
 def chrome_events(tracer, pid=0, process_name="riptide_tpu"):
@@ -41,13 +63,15 @@ def chrome_events(tracer, pid=0, process_name="riptide_tpu"):
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
             "args": {"name": tname},
         })
-    for name, ts, dur, tid, attrs in tracer.events():
+    for name, ts, dur, tid, attrs, sid in tracer.events():
         events.append({
             "name": name, "ph": "X", "cat": "riptide",
             "pid": pid, "tid": tid,
             "ts": round(ts * 1e6, 3),
             "dur": round(dur * 1e6, 3),
-            "args": attrs,
+            # span_id is the handle journal `incident` records carry,
+            # so an incident row finds its enclosing span in the file.
+            "args": dict(attrs, span_id=sid),
         })
     return events
 
@@ -119,21 +143,36 @@ def export_run_trace(directory, process_index=0, process_count=1,
     additionally merges every per-process file PRESENT AT THAT MOMENT
     into ``trace.json`` — best-effort, since peers finish at their own
     pace; re-running :func:`merge_chrome_traces` over the lane files
-    afterwards yields the complete picture."""
+    afterwards yields the complete picture.
+
+    A target file this tracer has not written before is first rotated
+    (``trace.json`` -> ``trace.json.1``, bounded depth): a RESUMED run
+    (fresh process, fresh tracer) preserves the killed attempt's trace
+    instead of overwriting it, while same-run re-exports (e.g. the
+    scheduler's end-of-search export followed by rffa's post-stage
+    re-export, or per-chunk multihost lane rewrites) keep overwriting
+    in place."""
     if tracer is None:
         from .trace import get_tracer
 
         tracer = get_tracer()
     if tracer is None:
         return None
+
+    def target(path):
+        if path not in tracer.exported_paths:
+            rotate_trace_file(path)
+            tracer.exported_paths.add(path)
+        return path
+
     merged_path = os.path.join(directory, "trace.json")
     if process_count <= 1:
-        return write_chrome_trace(merged_path, tracer)
+        return write_chrome_trace(target(merged_path), tracer)
     own = os.path.join(directory,
                        f"trace_{int(process_index):04d}.json")
-    write_chrome_trace(own, tracer, pid=int(process_index))
+    write_chrome_trace(target(own), tracer, pid=int(process_index))
     if int(process_index) == 0:
         lanes = sorted(glob.glob(os.path.join(directory,
                                               "trace_[0-9]*.json")))
-        merge_chrome_traces(lanes, merged_path)
+        merge_chrome_traces(lanes, target(merged_path))
     return own

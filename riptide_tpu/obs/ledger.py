@@ -1,0 +1,213 @@
+"""
+Append-only JSONL performance ledger: the machine-readable run history.
+
+The perf trajectory used to live in ad-hoc ``BENCH_r0*.json`` blobs and
+``docs/perf_notes.md`` prose — unmergeable, schema-free, and blind to
+*why* two rounds differ (different device? different flags? a slow
+tunnel?). The ledger fixes the schema: every bench.py pass, stime.py
+run and journaled survey appends ONE row to the file named by
+``RIPTIDE_LEDGER``, carrying
+
+* the run-level phase decomposition (the
+  :func:`riptide_tpu.obs.schema.decomposition` keys: ``prep_s`` /
+  ``wire_s`` / ``device_s`` / ``chunk_s`` / ``wire_MBps``) plus
+  ``nchunks`` and the per-chunk ``bound_counts`` (how many chunks were
+  tunnel- vs device-bound — the field that lets a regression check
+  discard tunnel-weather rows);
+* provenance that explains run-to-run deltas: git sha, a fingerprint
+  of every non-default ``RIPTIDE_*`` flag, the device platform
+  (backend / device kind / counts) and ``KERNEL_CACHE_VERSION``.
+
+Rows are single ``O_APPEND`` writes, fsync'd, one JSON object per line
+— the same torn-line-tolerant discipline as the survey journal — so
+concurrent writers (a bench and a survey) interleave without locks and
+a reader drops at most one torn tail line.
+
+``tools/rreport.py --compare <ledger>`` turns the file into a CI
+regression sentinel; :mod:`riptide_tpu.obs.report` holds the (jax-free)
+reading/comparison half.
+"""
+import hashlib
+import json
+import logging
+import os
+import subprocess
+from datetime import datetime, timezone
+
+from ..utils import envflags
+
+log = logging.getLogger("riptide_tpu.obs.ledger")
+
+__all__ = ["LEDGER_VERSION", "make_row", "append_row", "maybe_append",
+           "read_rows", "git_sha", "envflag_fingerprint",
+           "platform_info", "ledger_path"]
+
+LEDGER_VERSION = 1
+
+# Flags that only control the RECORDING of observability artifacts —
+# where the ledger/textfile goes, whether the status endpoint is up.
+# They cannot affect the measured run, and RIPTIDE_LEDGER in particular
+# is non-default in EVERY row (a row is only written while it is set),
+# so including them would make two perf-identical runs recording to
+# different paths fingerprint as different flag regimes.
+FINGERPRINT_EXCLUDE = frozenset({
+    "RIPTIDE_LEDGER", "RIPTIDE_PROM_PORT", "RIPTIDE_PROM_TEXTFILE",
+    "RIPTIDE_STATUS", "RIPTIDE_STATUS_STALE_S",
+})
+
+
+def ledger_path():
+    """The configured ledger path (``RIPTIDE_LEDGER``), or None when
+    ledger recording is disabled."""
+    return envflags.get("RIPTIDE_LEDGER")
+
+
+def git_sha(repo=None):
+    """Short git sha of the working tree this process runs from, or
+    None outside a checkout (an installed wheel has no history — the
+    row is still useful, just less attributable)."""
+    repo = repo or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo, "rev-parse", "--short=12", "HEAD"],
+            capture_output=True, text=True, timeout=10.0,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def envflag_fingerprint():
+    """``(digest, flags)``: a 12-hex digest over — and the dict of —
+    every registered ``RIPTIDE_*`` flag whose parsed value differs
+    from its default. Two rows with the same fingerprint ran under the
+    same flag regime; the dict says exactly what a differing one
+    changed. Recording-only flags (:data:`FINGERPRINT_EXCLUDE`) are
+    ignored — they cannot change the run being measured. Flags set to
+    unparsable values are recorded as their raw error string rather
+    than failing the run being ledgered."""
+    flags = {}
+    for name in sorted(envflags.FLAGS):
+        if name in FINGERPRINT_EXCLUDE:
+            continue
+        try:
+            value = envflags.get(name)
+        except Exception as err:  # unparsable operator value
+            flags[name] = f"<unparsable: {err}>"
+            continue
+        if value != envflags.FLAGS[name].default:
+            flags[name] = value
+    digest = hashlib.sha1(
+        json.dumps(flags, sort_keys=True, default=str).encode()
+    ).hexdigest()[:12]
+    return digest, flags
+
+
+def platform_info():
+    """Device/platform block of a row, via the engine's jax-backed
+    fingerprint when available (bench/stime/survey processes have jax
+    up anyway); ``{"backend": "unknown"}`` where jax is absent so the
+    ledger writer itself never requires a backend."""
+    try:
+        from ..search.engine import device_fingerprint
+
+        return device_fingerprint()
+    except Exception:
+        return {"backend": "unknown"}
+
+
+def _kernel_cache_version():
+    try:
+        from ..ops.ffa_kernel import KERNEL_CACHE_VERSION
+
+        return int(KERNEL_CACHE_VERSION)
+    except Exception:
+        return None
+
+
+def make_row(kind, decomposition, nchunks=None, bound_counts=None,
+             extra=None):
+    """One ledger row: the decomposition keys verbatim plus provenance.
+    ``bound_counts`` is ``{"device": n, "tunnel": m, ...}`` over the
+    run's chunks (a run without per-chunk timing records its run-level
+    classification as a single-entry count)."""
+    fp, flags = envflag_fingerprint()
+    row = {
+        "kind": str(kind),
+        "v": LEDGER_VERSION,
+        # Same stamp format as survey.journal._utc_iso (readers
+        # correlate ledger rows with journal records by utc; obs must
+        # not import the survey layer, so the format lives twice).
+        "utc": datetime.now(timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z",
+        "git_sha": git_sha(),
+        "envflags_fingerprint": fp,
+        "envflags": flags,
+        "platform": platform_info(),
+        "kernel_cache_version": _kernel_cache_version(),
+    }
+    row.update(decomposition or {})
+    if nchunks is not None:
+        row["nchunks"] = int(nchunks)
+    if bound_counts:
+        row["bound_counts"] = {str(k): int(v)
+                               for k, v in bound_counts.items()}
+    if extra:
+        row.update(extra)
+    return row
+
+
+def append_row(row, path):
+    """Append one row to ``path`` as a single fsync'd JSONL write (the
+    journal's atomic-append discipline: concurrent writers interleave
+    whole lines, a kill tears at most the final line)."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    data = (json.dumps(row, separators=(",", ":")) + "\n").encode()
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    return path
+
+
+def maybe_append(kind, decomposition, nchunks=None, bound_counts=None,
+                 extra=None, path=None):
+    """Build and append a row when a ledger is configured (``path`` or
+    ``RIPTIDE_LEDGER``); returns the path written, or None when ledger
+    recording is off. Best-effort by design: a full disk or bad path
+    must not take down the run it is recording."""
+    path = path or ledger_path()
+    if not path:
+        return None
+    row = make_row(kind, decomposition, nchunks=nchunks,
+                   bound_counts=bound_counts, extra=extra)
+    try:
+        append_row(row, path)
+    except OSError as err:
+        log.warning("ledger append to %r failed: %s", path, err)
+        return None
+    log.info("ledger: appended %s row to %s", kind, path)
+    return path
+
+
+def read_rows(path):
+    """Every parseable row of ``path``, oldest first; torn/garbage
+    lines are dropped (the reading half also lives jax-free in
+    :mod:`riptide_tpu.obs.report` for the standalone tools)."""
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path, "rb") as fobj:
+        for line in fobj.read().split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                log.warning("%s: dropping torn ledger line", path)
+    return out

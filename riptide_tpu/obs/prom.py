@@ -15,16 +15,25 @@ only *renders* a snapshot:
 * :func:`write_prom` — atomic textfile write (node_exporter
   textfile-collector format: tmp + rename, never a torn page);
 * :func:`serve` / :func:`maybe_serve` — an OPTIONAL stdlib-only
-  localhost HTTP endpoint serving ``/metrics`` from a daemon thread,
-  enabled by ``RIPTIDE_PROM_PORT`` — the daemon-ready half of the
+  localhost HTTP endpoint on a daemon thread, enabled by
+  ``RIPTIDE_PROM_PORT`` — the daemon-ready half of the
   survey-as-a-service roadmap item (a scraper polls a *running* survey
-  instead of waiting for its end-of-run snapshot);
+  instead of waiting for its end-of-run snapshot). It serves four
+  paths: ``/metrics`` (and ``/``, the text-format page), ``/status``
+  (live survey JSON from the installed *status provider* — chunks
+  done/parked/in-flight, EWMA rate, ETA, heartbeat ages, breaker
+  state, last incident; see :func:`set_status_provider`) and
+  ``/healthz`` (200 while healthy, **503** when the breaker is open or
+  the newest heartbeat is older than ``RIPTIDE_STATUS_STALE_S`` — the
+  liveness probe a supervisor or k8s readiness check points at). Any
+  other path is a 404 whose body names the valid endpoints;
 * :func:`maybe_write_textfile` — end-of-run textfile write when
   ``RIPTIDE_PROM_TEXTFILE`` is set (survey scheduler / rseek hook).
 
 Everything here must stay importable without jax: exposition is host
 plumbing and the lint/daemon layers load it standalone.
 """
+import json
 import logging
 import os
 import threading
@@ -35,7 +44,12 @@ from ..utils import envflags
 log = logging.getLogger("riptide_tpu.obs.prom")
 
 __all__ = ["render", "write_prom", "serve", "maybe_serve",
-           "maybe_write_textfile", "PROM_PREFIX"]
+           "maybe_write_textfile", "set_status_provider",
+           "status_snapshot", "health_check", "PROM_PREFIX", "ENDPOINTS"]
+
+# Every path the daemon answers; the 404 body enumerates them so a
+# mistyped scrape target is self-diagnosing.
+ENDPOINTS = ("/", "/metrics", "/status", "/healthz")
 
 PROM_PREFIX = "riptide"
 
@@ -48,6 +62,7 @@ _HELP = {
     "breaker_opens": "circuit-breaker transitions to open",
     "peer_losses": "collectives degraded to local-only mode",
     "oom_bisections": "DM-batch halvings after device OOM",
+    "incidents": "structured incident records emitted",
     "wire_bytes": "bytes shipped over the host->device wire",
     "queue_depth": "work items not yet collected",
     "heartbeat_age_s": "age of the stalest peer heartbeat",
@@ -131,30 +146,114 @@ def maybe_write_textfile(registry=None):
     return write_prom(path, registry)
 
 
+# Process-wide live-status provider: a zero-argument callable returning
+# the /status JSON dict, installed by whoever owns the run (the survey
+# scheduler registers one per run when RIPTIDE_STATUS is on). Resolved
+# per request so a second survey in the same process takes over cleanly.
+_status_provider = None
+_status_lock = threading.Lock()
+
+
+def set_status_provider(provider):
+    """Install ``provider()`` as the source of the ``/status`` page
+    (None uninstalls); returns the previous provider."""
+    global _status_provider
+    with _status_lock:
+        prev, _status_provider = _status_provider, provider
+    return prev
+
+
+def status_snapshot():
+    """The current ``/status`` document: the provider's dict plus
+    ``"active": True``, or ``{"active": False}`` when no survey has
+    registered one (the daemon may outlive — or predate — a run)."""
+    with _status_lock:
+        provider = _status_provider
+    if provider is None:
+        return {"active": False}
+    status = dict(provider())
+    status.setdefault("active", True)
+    return status
+
+
+def health_check(status=None, stale_s=None):
+    """``(healthy, problems)`` for the ``/healthz`` probe: unhealthy
+    when the circuit breaker is open or the newest heartbeat is older
+    than ``stale_s`` (default ``RIPTIDE_STATUS_STALE_S``) — the two
+    conditions under which a survey process is up but not making
+    progress. The probe answers "is the run wedged", not "is there a
+    run": a process with no registered status, or whose status says
+    ``running: false`` (the survey finished; its provider stays
+    registered so the final state remains queryable, but heartbeats
+    have legitimately stopped), is healthy — a supervisor must never
+    kill an idle process over a completed run's aging heartbeats."""
+    if status is None:
+        status = status_snapshot()
+    if not status.get("running", True):
+        return True, []
+    if stale_s is None:
+        stale_s = envflags.get("RIPTIDE_STATUS_STALE_S")
+    problems = []
+    if status.get("breaker") == "open":
+        problems.append("circuit breaker open")
+    ages = status.get("heartbeat_age_s") or {}
+    if ages:
+        freshest = min(ages.values())
+        if stale_s is not None and freshest > float(stale_s):
+            problems.append(
+                f"stale heartbeat: freshest beat {freshest:.1f}s old "
+                f"(> {float(stale_s):.1f}s)"
+            )
+    return (not problems), problems
+
+
 class _PromServer:
-    """Localhost /metrics endpoint on a daemon thread. ``close()`` is
-    idempotent; ``port`` is the bound port (useful with port 0)."""
+    """Localhost metrics/status endpoint on a daemon thread.
+    ``close()`` is idempotent; ``port`` is the bound port (useful with
+    port 0)."""
 
     def __init__(self, port, registry=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path.split("?")[0] not in ("/metrics", "/"):
-                    self.send_error(404)
-                    return
-                # Resolved at request time, not server start: a later
-                # set_registry (or, unpinned, a set_metrics swap) shows
-                # up on the next scrape instead of serving a registry
-                # frozen at whatever the first caller passed.
-                body = render(self.server._riptide_registry).encode()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4"
-                )
-                self.send_header("Content-Length", str(len(body)))
+            def _reply(self, code, body, ctype):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
-                self.wfile.write(body)
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?")[0]
+                if path in ("/metrics", "/"):
+                    # Resolved at request time, not server start: a
+                    # later set_registry (or, unpinned, a set_metrics
+                    # swap) shows up on the next scrape instead of
+                    # serving a registry frozen at whatever the first
+                    # caller passed.
+                    self._reply(200,
+                                render(self.server._riptide_registry),
+                                "text/plain; version=0.0.4")
+                elif path == "/status":
+                    self._reply(200, json.dumps(status_snapshot()),
+                                "application/json")
+                elif path == "/healthz":
+                    status = status_snapshot()
+                    ok, problems = health_check(status)
+                    self._reply(
+                        200 if ok else 503,
+                        json.dumps({"ok": ok, "problems": problems,
+                                    "status": status}),
+                        "application/json",
+                    )
+                else:
+                    self._reply(
+                        404,
+                        f"unknown path {path!r}; valid endpoints: "
+                        + ", ".join(ENDPOINTS) + "\n",
+                        "text/plain",
+                    )
 
             def log_message(self, fmt, *args):
                 log.debug("prom endpoint: " + fmt, *args)

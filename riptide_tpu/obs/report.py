@@ -1,0 +1,585 @@
+"""
+Post-run analysis of a survey's observability artifacts (jax-free).
+
+PR 8 made every run *emit* rich signals — journal chunk records with a
+phase-timing decomposition, structured incidents, a Chrome trace, a
+Prometheus snapshot — and this module *consumes* them: it merges a
+journal directory (plus an optional ``trace.json`` and prom textfile)
+into one report dict with
+
+* a **phase-attribution table** whose serial phases must sum to the
+  journaled chunk wall-clock (within :data:`PHASE_SUM_TOL`, and they
+  do by construction — a violation means a broken writer, and
+  ``tools/rreport.py`` exits nonzero on it);
+* **straggler chunks** (wall-clock far above the run median);
+* the **tunnel-rate distribution** (per-chunk ``wire_MBps`` against
+  the device tunnel's observed 4–70 MB/s swing) and the per-chunk
+  tunnel/device ``bound`` split;
+* the **incident timeline** (watchdog timeouts, breaker opens, OOM
+  bisections, quarantines, peer losses — with chunk and span ids);
+* a **noise-aware regression verdict** against a perf ledger
+  (:func:`compare_to_ledger`): the run's device seconds per chunk vs
+  the ledger history's median, with a band widened by the history's
+  own scatter (median absolute deviation), and tunnel-bound rows —
+  on either side — excluded from device-time comparisons, because a
+  tunnel-weather run says nothing about compute regressions.
+
+This module is deliberately **stdlib-only and self-contained**: it is
+importable as ``riptide_tpu.obs.report`` *and* loadable standalone by
+file path (``tools/rreport.py`` / ``tools/rtop.py`` do so), so tailing
+a running survey or auditing a ledger never needs a jax install.
+"""
+import glob
+import json
+import os
+
+__all__ = [
+    "PHASE_SUM_TOL", "SERIAL_PHASES", "JournalFollower", "read_journal",
+    "read_heartbeats", "read_ledger", "parse_prom_text",
+    "load_trace_summary", "run_decomposition_from_chunks",
+    "phase_attribution", "stragglers", "tunnel_stats", "build_report",
+    "render_text", "compare_to_ledger", "latest_platform",
+    "drop_own_row",
+]
+
+# Relative tolerance on |sum(serial phases) - chunk_s| (the acceptance
+# bound; the writer makes the sum exact, so slack only absorbs the
+# 6-decimal rounding of journaled values).
+PHASE_SUM_TOL = 0.05
+
+# The journal timing keys that must reconstruct chunk_s (prep_s is
+# reported but overlapped, hence excluded — see obs.schema).
+SERIAL_PHASES = ("wire_s", "queue_s", "collect_s", "host_s")
+
+# A chunk this many times slower than the run median is a straggler.
+STRAGGLER_FACTOR = 2.0
+
+# The tunnel's historically observed transfer-rate swing (MB/s) and the
+# knee below which it binds the headline (docs/perf_notes.md).
+TUNNEL_SWING_MBPS = (4.0, 70.0)
+TUNNEL_KNEE_MBPS = 25.0
+
+
+# ---------------------------------------------------------------- reading
+
+def _read_jsonl(path):
+    """Parsed objects of every complete line; torn/garbage lines are
+    dropped (the journal's own tolerance, reimplemented here so the
+    reader stays importable without the package)."""
+    if not os.path.exists(path):
+        return []
+    with open(path, "rb") as fobj:
+        raw = fobj.read()
+    out = []
+    for line in raw.split(b"\n"):
+        if not line.strip():
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            pass
+    return out
+
+
+class JournalFollower:
+    """Incremental journal reader for long-lived monitors (rtop).
+
+    Keeps a byte offset into ``journal.jsonl`` between polls and folds
+    only the *appended complete lines* into its running state, so each
+    poll costs O(new data) rather than O(survey length) — the
+    discipline a monitor watching a long campaign over a shared
+    filesystem must keep. :meth:`poll` returns the same dict shape as
+    :func:`read_journal` (which is a one-shot follower):
+
+        {"directory", "header", "chunks": {cid: record},
+         "parked": {cid: record}, "incidents": [...],
+         "metrics": last summary or None}
+
+    ``chunks`` keeps the LAST record per chunk id (a retried chunk's
+    final journaling wins, matching the resume loader); ``parked``
+    holds only chunks never subsequently completed. Journals written
+    before incidents/utc existed parse identically (missing fields stay
+    missing — every consumer here treats them as optional). A torn or
+    still-being-written tail line does not advance the offset, so it is
+    re-read whole on a later poll; a shrunken file (journal replaced)
+    resets the state and re-reads from the start."""
+
+    def __init__(self, journal_dir):
+        self.directory = os.path.abspath(journal_dir)
+        self._path = os.path.join(journal_dir, "journal.jsonl")
+        self._offset = 0
+        self._reset()
+
+    def _reset(self):
+        self._header = None
+        self._chunks, self._parked, self._incidents = {}, {}, []
+        self._metrics = None
+
+    def _fold(self, rec):
+        kind = rec.get("kind")
+        if kind == "header" and self._header is None:
+            self._header = rec
+        elif kind == "chunk":
+            self._chunks[int(rec.get("chunk_id", -1))] = rec
+        elif kind == "parked":
+            self._parked[int(rec.get("chunk_id", -1))] = rec
+        elif kind == "incident":
+            self._incidents.append(rec)
+        elif kind == "metrics":
+            self._metrics = rec.get("summary", self._metrics)
+
+    def poll(self):
+        """Fold any newly appended records and return the current
+        state (see the class docstring for the shape)."""
+        raw = b""
+        try:
+            with open(self._path, "rb") as fobj:
+                fobj.seek(0, os.SEEK_END)
+                if fobj.tell() < self._offset:
+                    self._offset = 0
+                    self._reset()
+                fobj.seek(self._offset)
+                raw = fobj.read()
+        except OSError:
+            pass
+        end = raw.rfind(b"\n")
+        if end >= 0:
+            for line in raw[:end].split(b"\n"):
+                if not line.strip():
+                    continue
+                try:
+                    self._fold(json.loads(line))
+                except ValueError:
+                    pass
+            self._offset += end + 1
+        parked = {cid: rec for cid, rec in self._parked.items()
+                  if cid not in self._chunks}
+        return {"directory": self.directory, "header": self._header,
+                "chunks": dict(self._chunks), "parked": parked,
+                "incidents": list(self._incidents),
+                "metrics": self._metrics}
+
+
+def read_journal(journal_dir):
+    """One-shot parse of a journal directory into its record families
+    (a fresh :class:`JournalFollower`'s first poll — see there for the
+    shape and tolerance guarantees)."""
+    return JournalFollower(journal_dir).poll()
+
+
+def read_heartbeats(journal_dir, tail_bytes=4096):
+    """``{process_index: newest heartbeat unix timestamp}`` from the
+    ``heartbeat_*.jsonl`` sidecars, reading only each file's tail (the
+    journal's own tail-read discipline — a monitor must stay O(1) in
+    survey length)."""
+    out = {}
+    for path in glob.glob(os.path.join(journal_dir, "heartbeat_*.jsonl")):
+        try:
+            with open(path, "rb") as fobj:
+                fobj.seek(0, os.SEEK_END)
+                size = fobj.tell()
+                fobj.seek(max(0, size - tail_bytes))
+                tail = fobj.read()
+        except OSError:
+            continue
+        for line in reversed([l for l in tail.split(b"\n") if l.strip()]):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "ts" in rec:
+                out[int(rec.get("process", -1))] = float(rec["ts"])
+                break
+    return out
+
+
+def read_ledger(path):
+    """Every parseable ledger row, oldest first (see obs.ledger)."""
+    return _read_jsonl(path)
+
+
+def parse_prom_text(text):
+    """``{series_name: {label_string_or_'': value}}`` from a Prometheus
+    text-format page (permissive; HELP/TYPE lines are skipped)."""
+    values = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        try:
+            lhs, val = line.rsplit(None, 1)
+            name, _, labels = lhs.partition("{")
+            values.setdefault(name, {})[labels.rstrip("}")] = float(val)
+        except ValueError:
+            pass
+    return values
+
+
+def load_trace_summary(path):
+    """Compact summary of a Chrome trace file: per-span-name totals and
+    counts, the lane count, and how many spans the bounded ring
+    dropped (a truncation warning for the report)."""
+    with open(path) as fobj:
+        doc = json.load(fobj)
+    totals, counts, tids = {}, {}, set()
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "?")
+        totals[name] = totals.get(name, 0.0) + ev.get("dur", 0.0) / 1e6
+        counts[name] = counts.get(name, 0) + 1
+        tids.add(ev.get("tid"))
+    other = doc.get("otherData", {})
+    return {"path": os.path.abspath(path),
+            "span_totals_s": {k: round(v, 6) for k, v in totals.items()},
+            "span_counts": counts, "lanes": len(tids),
+            "dropped_events": other.get("dropped_events", 0)}
+
+
+# ------------------------------------------------------------- aggregation
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    if not n:
+        return None
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def run_decomposition_from_chunks(timings):
+    """Run-level decomposition derived from journal chunk ``timings``
+    blocks: phase totals, mean ``chunk_s``, median per-chunk
+    ``wire_MBps``, ``nchunks`` and the ``bound_counts`` split. This is
+    the ONE derivation shared by the scheduler's ledger row and
+    rreport's comparison side, so a run always compares equal against
+    its own ledger row."""
+    timings = [t for t in timings if t]
+    n = len(timings)
+    out = {"prep_s": 0.0, "wire_s": 0.0, "device_s": 0.0,
+           "chunk_s": 0.0, "wire_MBps": None}
+    bound_counts = {}
+    if not n:
+        return out, 0, bound_counts
+    for key in ("prep_s", "wire_s", "device_s"):
+        out[key] = round(sum(float(t.get(key, 0.0)) for t in timings), 6)
+    out["chunk_s"] = round(
+        sum(float(t.get("chunk_s", 0.0)) for t in timings) / n, 6)
+    rates = [float(t["wire_MBps"]) for t in timings
+             if t.get("wire_MBps") is not None]
+    if rates:
+        out["wire_MBps"] = round(_median(rates), 3)
+    for t in timings:
+        b = t.get("bound", "unknown")
+        bound_counts[b] = bound_counts.get(b, 0) + 1
+    return out, n, bound_counts
+
+
+def phase_attribution(chunks):
+    """Phase-attribution rows over the journaled chunks: per-phase
+    total seconds and share of serial wall-clock, plus the per-chunk
+    sum check. Returns ``(rows, violations)`` where ``rows`` is
+    ``[(phase, total_s, share), ...]`` (prep last, marked overlapped)
+    and ``violations`` lists chunks whose serial phases do NOT
+    reconstruct ``chunk_s`` within :data:`PHASE_SUM_TOL`."""
+    totals = {p: 0.0 for p in SERIAL_PHASES}
+    prep = wall = 0.0
+    violations = []
+    for cid in sorted(chunks):
+        t = chunks[cid].get("timings") or {}
+        if not t:
+            continue
+        chunk_s = float(t.get("chunk_s", 0.0))
+        serial = sum(float(t.get(p, 0.0)) for p in SERIAL_PHASES)
+        if abs(serial - chunk_s) > PHASE_SUM_TOL * max(chunk_s, 1e-9):
+            violations.append(
+                {"chunk_id": cid, "serial_s": round(serial, 6),
+                 "chunk_s": round(chunk_s, 6)})
+        for p in SERIAL_PHASES:
+            totals[p] += float(t.get(p, 0.0))
+        prep += float(t.get("prep_s", 0.0))
+        wall += chunk_s
+    rows = [(p, round(totals[p], 6),
+             round(totals[p] / wall, 4) if wall > 0 else 0.0)
+            for p in SERIAL_PHASES]
+    rows.append(("prep (overlapped)", round(prep, 6), None))
+    return rows, violations
+
+
+def stragglers(chunks, factor=STRAGGLER_FACTOR):
+    """Chunks whose wall-clock exceeds ``factor`` x the run median:
+    ``[(chunk_id, chunk_s, ratio), ...]``, slowest first."""
+    walls = {cid: float((rec.get("timings") or {}).get("chunk_s", 0.0))
+             for cid, rec in chunks.items()
+             if rec.get("timings")}
+    med = _median([w for w in walls.values() if w > 0])
+    if not med:
+        return []
+    out = [(cid, round(w, 6), round(w / med, 2))
+           for cid, w in walls.items() if w > factor * med]
+    return sorted(out, key=lambda r: -r[1])
+
+
+def tunnel_stats(chunks):
+    """Per-chunk wire-rate distribution vs the tunnel's 4–70 MB/s
+    swing, plus the ``bound`` split — the report section that makes
+    the bench's dominant noise source attributable."""
+    rates, bound_counts = [], {}
+    for rec in chunks.values():
+        t = rec.get("timings") or {}
+        if t.get("wire_MBps") is not None:
+            rates.append(float(t["wire_MBps"]))
+        b = t.get("bound")
+        if b:
+            bound_counts[b] = bound_counts.get(b, 0) + 1
+    out = {"bound_counts": bound_counts, "n_rates": len(rates)}
+    if rates:
+        out.update({
+            "wire_MBps_min": round(min(rates), 3),
+            "wire_MBps_median": round(_median(rates), 3),
+            "wire_MBps_max": round(max(rates), 3),
+            "chunks_below_knee": sum(1 for r in rates
+                                     if r < TUNNEL_KNEE_MBPS),
+            "knee_MBps": TUNNEL_KNEE_MBPS,
+            "swing_MBps": list(TUNNEL_SWING_MBPS),
+        })
+    return out
+
+
+# ------------------------------------------------------------ the report
+
+def build_report(journal_dir, trace_path=None, prom_path=None):
+    """The full report dict over one journal directory (plus optional
+    trace/prom artifacts). ``trace_path``/``prom_path`` default to the
+    conventional files next to the journal when present."""
+    j = read_journal(journal_dir)
+    chunks = j["chunks"]
+    rows, violations = phase_attribution(chunks)
+    run, nchunks, bound_counts = run_decomposition_from_chunks(
+        [rec.get("timings") for rec in chunks.values()])
+    report = {
+        "directory": j["directory"],
+        "survey_id": (j["header"] or {}).get("survey_id"),
+        "chunks_total": (j["header"] or {}).get("chunks_total"),
+        "chunks_done": len(chunks),
+        "chunks_parked": len(j["parked"]),
+        "parked": {cid: rec.get("reason")
+                   for cid, rec in j["parked"].items()},
+        "run": dict(run, nchunks=nchunks, bound_counts=bound_counts),
+        "phase_table": rows,
+        "phase_sum_violations": violations,
+        "stragglers": stragglers(chunks),
+        "tunnel": tunnel_stats(chunks),
+        "incidents": j["incidents"],
+        "metrics": j["metrics"],
+    }
+    if trace_path is None:
+        cand = os.path.join(journal_dir, "trace.json")
+        trace_path = cand if os.path.exists(cand) else None
+    if trace_path:
+        try:
+            report["trace"] = load_trace_summary(trace_path)
+        except (OSError, ValueError) as err:
+            report["trace_error"] = f"{trace_path}: {err}"
+    if prom_path and os.path.exists(prom_path):
+        with open(prom_path) as fobj:
+            report["prom"] = parse_prom_text(fobj.read())
+    return report
+
+
+def render_text(report):
+    """The human form of :func:`build_report`'s dict."""
+    lines = []
+    add = lines.append
+    add(f"survey {report.get('survey_id') or '<unknown>'} "
+        f"({report['directory']})")
+    total = report.get("chunks_total")
+    add(f"chunks: {report['chunks_done']} done"
+        + (f" / {total} total" if total is not None else "")
+        + (f", {report['chunks_parked']} parked"
+           if report.get("chunks_parked") else ""))
+    run = report["run"]
+    add("")
+    add("phase attribution (serial phases sum to chunk wall-clock):")
+    for phase, total_s, share in report["phase_table"]:
+        pct = "  overlap" if share is None else f"{100 * share:7.1f}%"
+        add(f"  {phase:<18} {total_s:10.3f} s  {pct}")
+    add(f"  mean chunk_s {run['chunk_s']:.3f} s over "
+        f"{run['nchunks']} chunk(s); bound: "
+        + (", ".join(f"{k}={v}"
+                     for k, v in sorted(run["bound_counts"].items()))
+           or "n/a"))
+    for v in report["phase_sum_violations"]:
+        add(f"  !! chunk {v['chunk_id']}: serial phases sum to "
+            f"{v['serial_s']}s but chunk_s={v['chunk_s']}s")
+    tun = report["tunnel"]
+    if tun.get("n_rates"):
+        add("")
+        add(f"tunnel: wire rate min/median/max "
+            f"{tun['wire_MBps_min']}/{tun['wire_MBps_median']}/"
+            f"{tun['wire_MBps_max']} MB/s "
+            f"(historical swing {tun['swing_MBps'][0]}-"
+            f"{tun['swing_MBps'][1]}); "
+            f"{tun['chunks_below_knee']}/{tun['n_rates']} chunk(s) "
+            f"below the {tun['knee_MBps']} MB/s knee")
+    if report["stragglers"]:
+        add("")
+        add("stragglers (> {:.1f}x median chunk_s):".format(
+            STRAGGLER_FACTOR))
+        for cid, chunk_s, ratio in report["stragglers"]:
+            add(f"  chunk {cid}: {chunk_s:.3f} s ({ratio}x median)")
+    if report["incidents"]:
+        add("")
+        add(f"incident timeline ({len(report['incidents'])}):")
+        for inc in report["incidents"]:
+            where = (f" chunk {inc['chunk_id']}"
+                     if "chunk_id" in inc else "")
+            sid = (f" span {inc['span_id']}"
+                   if "span_id" in inc else "")
+            add(f"  {inc.get('utc', '?'):<26} "
+                f"{inc.get('incident', '?')}{where}{sid}")
+    if "trace" in report:
+        tr = report["trace"]
+        add("")
+        add(f"trace: {sum(tr['span_counts'].values())} span(s) on "
+            f"{tr['lanes']} lane(s)"
+            + (f", {tr['dropped_events']} dropped by the ring"
+               if tr["dropped_events"] else "")
+            + f" ({tr['path']})")
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------- comparison
+
+def _bound_majority(bound_counts):
+    """The dominant ``bound`` label of a run ('unknown' when empty)."""
+    if not bound_counts:
+        return "unknown"
+    return max(sorted(bound_counts), key=lambda k: bound_counts[k])
+
+
+def _device_per_chunk(row):
+    dev = row.get("device_s")
+    n = row.get("nchunks")
+    if not dev or not n:
+        return None
+    return float(dev) / int(n)
+
+
+def drop_own_row(rows, survey_id):
+    """``(rows', dropped)`` with the NEWEST row whose ``survey_id``
+    matches removed. The canonical CI flow appends the run's own
+    ledger row at end of run *before* ``rreport --compare`` reads the
+    ledger; left in, that row dilutes a short baseline's median/MAD
+    with the very value under test (one good historical row + a 2x
+    regressed own row compares "ok"). Only the newest match is dropped:
+    a nightly re-run of the same survey shares its survey_id with ALL
+    its history, which must stay in the baseline."""
+    if not survey_id:
+        return list(rows), False
+    for i in range(len(rows) - 1, -1, -1):
+        if rows[i].get("survey_id") == survey_id:
+            return rows[:i] + rows[i + 1:], True
+    return list(rows), False
+
+
+def latest_platform(rows, kind=None):
+    """The ``platform`` block of the NEWEST row carrying one (rows are
+    append-ordered; optionally restricted to one ``kind``), or None.
+    ``rreport --compare``'s default baseline filter: the newest row is
+    normally the run under comparison's own end-of-run append, so its
+    platform is the platform the verdict should be scoped to."""
+    for row in reversed(rows):
+        if kind is not None and row.get("kind") != kind:
+            continue
+        platform = row.get("platform")
+        if isinstance(platform, dict) and platform.get("backend") not in (
+                None, "unknown"):
+            return {k: platform.get(k)
+                    for k in ("backend", "device_kind")}
+    return None
+
+
+def _platform_matches(row, platform):
+    got = row.get("platform") or {}
+    return all(got.get(k) == v for k, v in platform.items()
+               if v is not None)
+
+
+def compare_to_ledger(current, rows, rel_tol=0.15, mad_k=3.0,
+                      kind=None, platform=None):
+    """Noise-aware regression verdict of ``current`` (a report's
+    ``run`` block, or any ledger-shaped row) against history ``rows``.
+
+    The compared quantity is **device seconds per chunk** — the number
+    the tunnel's transfer weather cannot touch. Tunnel-bound rows are
+    excluded from the baseline, and a tunnel-bound *current* run
+    produces a ``skipped-tunnel`` verdict (exit 0): when the wire
+    dominates, device time is overlap-polluted and a comparison would
+    alias tunnel weather into a compute verdict. The regression band
+    is ``median * (1 + rel_tol) + mad_k * MAD`` over the baseline — a
+    noisy history widens its own band instead of paging on scatter.
+
+    A shared ledger holds rows that are NOT comparable perf points —
+    bench passes next to survey runs, cpu smoke rows next to TPU rows
+    (``device_fingerprint``'s contract: a cpu-backend row must never
+    baseline a TPU regression check). ``kind`` restricts the baseline
+    to rows of that kind; ``platform`` (a dict of ``backend`` /
+    ``device_kind``) to rows matching it — both are counted in the
+    verdict when they exclude anything.
+
+    Returns ``(verdict dict, exit_code)``: 0 for ok / skipped /
+    no-baseline, 1 for a regression (the CI contract of
+    ``rreport --compare``)."""
+    cur_dev = _device_per_chunk(current)
+    cur_bound = _bound_majority(current.get("bound_counts") or {})
+    verdict = {"metric": "device_s_per_chunk",
+               "current": None if cur_dev is None else round(cur_dev, 6),
+               "current_bound": cur_bound}
+    if kind is not None:
+        verdict["kind"] = kind
+    if platform is not None:
+        verdict["platform"] = platform
+    if cur_dev is None:
+        verdict["verdict"] = "no-data"
+        return verdict, 0
+    if cur_bound == "tunnel":
+        verdict["verdict"] = "skipped-tunnel"
+        return verdict, 0
+
+    base, excluded, excluded_scope = [], 0, 0
+    for row in rows:
+        dev = _device_per_chunk(row)
+        if dev is None:
+            continue
+        if (kind is not None and row.get("kind") != kind) or \
+                (platform is not None
+                 and not _platform_matches(row, platform)):
+            excluded_scope += 1
+            continue
+        if _bound_majority(row.get("bound_counts") or {}) == "tunnel":
+            excluded += 1
+            continue
+        base.append(dev)
+    verdict["baseline_n"] = len(base)
+    verdict["excluded_tunnel_rows"] = excluded
+    if excluded_scope:
+        verdict["excluded_scope_rows"] = excluded_scope
+    if not base:
+        verdict["verdict"] = "no-baseline"
+        return verdict, 0
+
+    med = _median(base)
+    mad = _median([abs(v - med) for v in base])
+    threshold = med * (1.0 + float(rel_tol)) + float(mad_k) * mad
+    verdict.update({
+        "baseline_median": round(med, 6),
+        "baseline_mad": round(mad, 6),
+        "threshold": round(threshold, 6),
+    })
+    if cur_dev > threshold:
+        verdict["verdict"] = "regression"
+        verdict["ratio"] = round(cur_dev / med, 3)
+        return verdict, 1
+    verdict["verdict"] = "ok"
+    verdict["ratio"] = round(cur_dev / med, 3)
+    return verdict, 0

@@ -32,6 +32,7 @@ appear inside jit-decorated bodies or Pallas kernel closures (riplint
 RIP008): spans time *host-side* phases; device-side timelines are the
 ``jax.profiler`` exporter's job.
 """
+import itertools
 import threading
 import time
 from collections import deque
@@ -39,7 +40,7 @@ from collections import deque
 from ..utils import envflags
 
 __all__ = ["Span", "Tracer", "span", "enable", "disable", "enabled",
-           "get_tracer", "set_tracer", "NULL_SPAN"]
+           "get_tracer", "set_tracer", "current_span_id", "NULL_SPAN"]
 
 # Attribute keys a nested span inherits from its innermost enclosing
 # span when it does not set them itself (chunk attribution for
@@ -73,9 +74,15 @@ class Span:
     Use only as a context manager (``with span(...) as s:``) — manual
     ``__enter__`` without a guaranteed ``__exit__`` leaks the
     per-thread stack entry (riplint RIP008 rejects it statically).
+
+    Every entered span draws a process-unique ``sid`` from the tracer's
+    counter; the Chrome export carries it as ``span_id`` and the
+    journal's ``incident`` records reference it
+    (:func:`current_span_id`), so an incident row can be correlated
+    with the exact span that was open when it fired.
     """
 
-    __slots__ = ("name", "attrs", "t0", "tid", "_tracer")
+    __slots__ = ("name", "attrs", "t0", "tid", "sid", "_tracer")
 
     def __init__(self, tracer, name, attrs):
         self._tracer = tracer
@@ -97,6 +104,7 @@ class Span:
                     self.attrs[key] = parent[key]
         stack.append(self)
         self.tid = threading.get_ident()
+        self.sid = next(tr._ids)
         self.t0 = tr._clock()
         return self
 
@@ -112,7 +120,8 @@ class Span:
             stack.remove(self)
         if exc_type is not None:
             self.attrs["error"] = exc_type.__name__
-        tr._record(self.name, self.t0, dur, self.tid, self.attrs)
+        tr._record(self.name, self.t0, dur, self.tid, self.attrs,
+                   self.sid)
         return False
 
 
@@ -137,6 +146,15 @@ class Tracer:
         self._local = threading.local()
         self._recorded = 0
         self._thread_names = {}
+        # Process-unique span ids (drawn at span __enter__; CPython's
+        # itertools.count.__next__ is atomic, no lock needed). They link
+        # incident records to the span open when the incident fired.
+        self._ids = itertools.count(1)
+        # Trace-file paths export_run_trace has already written from
+        # THIS tracer: a same-run re-export overwrites in place, while
+        # a fresh process (a resumed run) rotates the prior attempt's
+        # file to <path>.1 instead of destroying it.
+        self.exported_paths = set()
         # Paired monotonic/UTC anchors: every event timestamp is
         # monotonic-relative to t0; wall_t0 places t0 in absolute time.
         self.t0 = clock()
@@ -155,19 +173,19 @@ class Tracer:
             stack = self._local.stack = []
         return stack
 
-    def _record(self, name, t0, dur, tid, attrs):
+    def _record(self, name, t0, dur, tid, attrs, sid):
         with self._lock:
             if tid not in self._thread_names:
                 self._thread_names[tid] = threading.current_thread().name
-            self._events.append((name, t0 - self.t0, dur, tid, attrs))
+            self._events.append((name, t0 - self.t0, dur, tid, attrs, sid))
             self._recorded += 1
 
     # -- reading ------------------------------------------------------------
 
     def events(self):
-        """Snapshot of the ring: ``[(name, ts_s, dur_s, tid, attrs),
-        ...]`` with ``ts_s`` seconds since the tracer's monotonic
-        anchor, oldest first."""
+        """Snapshot of the ring: ``[(name, ts_s, dur_s, tid, attrs,
+        sid), ...]`` with ``ts_s`` seconds since the tracer's monotonic
+        anchor, oldest first, and ``sid`` the process-unique span id."""
         with self._lock:
             return list(self._events)
 
@@ -192,7 +210,7 @@ class Tracer:
         """``{span name: total seconds}`` over the ring — a quick
         sanity cross-check against the metrics registry's timers."""
         out = {}
-        for name, _, dur, _, _ in self.events():
+        for name, _, dur, _, _, _ in self.events():
             out[name] = out.get(name, 0.0) + dur
         return out
 
@@ -242,6 +260,18 @@ def enabled():
 def get_tracer():
     """The active tracer, or None while tracing is disabled."""
     return _tracer
+
+
+def current_span_id():
+    """The ``sid`` of the calling thread's innermost OPEN span, or None
+    when tracing is disabled or no span is open. Incident records
+    attach it so a journal incident can be correlated with the exact
+    span in the exported trace (where it appears as ``span_id``)."""
+    tr = _tracer
+    if tr is None:
+        return None
+    stack = tr._stack()
+    return stack[-1].sid if stack else None
 
 
 def set_tracer(tracer):

@@ -36,6 +36,7 @@ import jax
 
 from ..obs.trace import span
 from ..peak_detection import PEAK_FIELDS, PEAK_INT_FIELDS, Peak
+from ..survey import incidents
 from ..survey.liveness import PeerTimeout, bounded_allgather
 from ..survey.metrics import get_metrics
 
@@ -73,6 +74,8 @@ def _degrade(reason):
         )
     _degraded = True
     get_metrics().add("peer_losses")
+    incidents.emit("peer_loss", reason=str(reason),
+                   process=int(jax.process_index()))
 
 # Peak is a flat record of 8 numeric fields; encode/decode as float64
 # in the canonical PEAK_FIELDS order (shared with the survey journal).

@@ -37,6 +37,7 @@ from ..search.engine import (
     collect_search_batch, is_oom_error, queue_search_batch,
     run_search_batch,
 )
+from ..survey import incidents
 from ..survey.metrics import get_metrics
 from ..time_series import TimeSeries
 
@@ -419,6 +420,8 @@ class BatchSearcher:
         if D <= self.oom_floor:
             raise err
         get_metrics().add("oom_bisections")
+        incidents.emit("oom_bisection", batch=D, halves=[(D + 1) // 2,
+                                                         D - (D + 1) // 2])
         log.warning(
             "device OOM on a %d-trial batch (%s); bisecting into %d + %d",
             D, err, (D + 1) // 2, D - (D + 1) // 2,
@@ -446,6 +449,8 @@ class BatchSearcher:
                 raise
             get_metrics().add("oom_bisections")
             mid = lo + (D + 1) // 2
+            incidents.emit("oom_bisection", batch=D,
+                           halves=[mid - lo, hi - mid])
             log.warning(
                 "device OOM on a %d-trial sub-batch (%s); bisecting into "
                 "%d + %d", D, err, mid - lo, hi - mid,

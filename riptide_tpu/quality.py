@@ -380,6 +380,11 @@ def quarantine_check(report, max_masked_frac, record=True):
         )
     if record:
         get_metrics().add("series_quarantined")
+        from .survey.incidents import emit as emit_incident
+
+        emit_incident("quarantine", fname=report.fname,
+                      masked_frac=round(report.masked_frac, 6),
+                      reasons=list(report.reasons))
     warnings.warn(DegradedInputWarning(report.fname or "<series>",
                                        report.describe()))
     log.warning("quarantined: %s", report.describe())

@@ -44,7 +44,22 @@ from ..ops.snr import snr_batched
 
 __all__ = ["run_periodogram", "run_periodogram_batch", "run_search_batch",
            "queue_search_batch", "collect_search_batch", "search_snr_dev",
-           "cycle_fn", "is_oom_error", "is_timeout_error"]
+           "cycle_fn", "is_oom_error", "is_timeout_error",
+           "device_fingerprint"]
+
+
+def device_fingerprint():
+    """Compact identity of the device platform this process dispatches
+    to: the perf ledger's ``platform`` block (two rows with different
+    fingerprints are not comparable perf points — a cpu-backend row
+    must never baseline a TPU regression check)."""
+    devices = jax.devices()
+    return {
+        "backend": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else None,
+        "device_count": len(devices),
+        "process_count": jax.process_count(),
+    }
 
 
 # Substrings identifying device memory exhaustion in an exception

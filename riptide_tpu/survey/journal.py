@@ -13,7 +13,11 @@ Two JSONL files live in the journal directory:
   newer fields, so pre-existing journals resume unchanged),
   ``parked`` records for chunks the circuit breaker set aside (a
   parked chunk has no completed record, so a later resume re-dispatches
-  it) and optional ``metrics`` snapshots.
+  it), structured ``incident`` records (watchdog timeouts, breaker
+  opens, OOM bisections, quarantines, peer losses — see
+  :mod:`riptide_tpu.survey.incidents`; invisible to kind-filtering
+  readers, so pre-incident journals and readers interoperate both
+  ways) and optional ``metrics`` snapshots.
 
 Per-process ``heartbeat_<p>.jsonl`` sidecars carry liveness beats for
 multi-host peer-loss detection: each process appends only to its OWN
@@ -218,6 +222,17 @@ class SurveyJournal:
                                          "utc": _utc_iso(),
                                          "summary": summary})
 
+    def record_incident(self, record):
+        """Append one structured ``incident`` record (built by
+        :func:`riptide_tpu.survey.incidents.emit` — watchdog timeout,
+        breaker open, OOM bisection, quarantine, peer loss, ...).
+        Purely additive for every reader: resume, heartbeat and metrics
+        loaders all filter by ``kind`` and never see these lines."""
+        rec = dict(record)
+        rec.setdefault("kind", "incident")
+        rec.setdefault("utc", _utc_iso())
+        _append_line(self.journal_path, rec)
+
     def heartbeat(self, process_index, ts=None):
         """Append one liveness beat to THIS process's sidecar
         (``heartbeat_<p>.jsonl``). Sidecars are single-writer by
@@ -278,6 +293,13 @@ class SurveyJournal:
             if isinstance(rec, dict) and "ts" in rec:
                 out[int(rec.get("process", -1))] = float(rec["ts"])
         return out
+
+    def incidents(self):
+        """Every ``incident`` record, in journal (append) order — the
+        raw material of rreport's incident timeline. Journals written
+        before incident records existed return an empty list."""
+        return [rec for rec in self._records()
+                if rec.get("kind") == "incident"]
 
     def last_metrics(self):
         """Most recent journaled metrics summary, or None."""

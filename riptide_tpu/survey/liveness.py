@@ -254,6 +254,18 @@ class ChunkWatchdog:
                 chunk_id, budget, self.ewma.value or float("nan"),
                 self.ewma.count, self._timeouts,
             )
+            # Forensic record next to the count: which chunk, at what
+            # budget, under which EWMA — the incident a post-mortem
+            # (rreport's timeline) pivots on.
+            from .incidents import emit as emit_incident
+
+            emit_incident(
+                "watchdog_timeout", chunk_id=chunk_id,
+                budget_s=round(budget, 3),
+                ewma_s=(None if self.ewma.value is None
+                        else round(self.ewma.value, 3)),
+                consecutive=self._timeouts,
+            )
             raise ChunkTimeout(chunk_id, budget)
         if "error" in box:
             raise box["error"]

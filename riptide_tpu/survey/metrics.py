@@ -35,6 +35,7 @@ contains whatever was recorded):
 ``breaker_opens``         counter: circuit-breaker closed/half-open -> open
 ``chunks_parked``         counter: chunks set aside by the open breaker
 ``peer_losses``           counter: collectives degraded to local-only mode
+``incidents``             counter: structured incident records emitted
 ``heartbeat_age_s``       gauge: age of the stalest peer heartbeat
 ========================  ====================================================
 
@@ -200,7 +201,7 @@ class MetricsRegistry:
         # Survey-health counters keep a stable schema: always present,
         # zero when the corresponding machinery never fired.
         for name in ("chunks_timed_out", "breaker_opens", "chunks_parked",
-                     "peer_losses"):
+                     "peer_losses", "incidents"):
             out.setdefault(name, 0)
         return out
 

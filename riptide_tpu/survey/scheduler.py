@@ -30,6 +30,16 @@ collect) in a resumable queue:
 
 Fault injection (:mod:`riptide_tpu.survey.faults`) hooks the dispatch
 path so all of the above is testable on the CPU backend.
+
+Observability: for the run's duration the journal doubles as the
+process-wide *incident sink* (watchdog timeouts, breaker opens, parks,
+OOM bisections, quarantines, peer losses land as structured
+``incident`` records next to the chunk records), journaled runs
+heartbeat every chunk even single-process, :meth:`SurveyScheduler.status`
+serves the live ``/status`` + ``/healthz`` surface on the Prometheus
+endpoint, and each run appends one row to the perf ledger
+(``RIPTIDE_LEDGER``) for ``tools/rreport.py --compare`` regression
+checks.
 """
 import hashlib
 import logging
@@ -42,6 +52,8 @@ from ..obs import prom
 from ..obs.chrome import export_run_trace
 from ..obs.schema import chunk_timing
 from ..obs.trace import span
+from ..utils import envflags
+from . import incidents
 from .faults import FaultAbort, FaultPlan
 from .liveness import is_timeout_error
 from .metrics import get_metrics
@@ -246,6 +258,8 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = self._clock()
         (self.metrics or get_metrics()).add("breaker_opens")
+        incidents.emit("breaker_open", cooldown_s=self.cooldown_s,
+                       failure_threshold=self.failure_threshold)
 
 
 def _wire_digest(items):
@@ -320,6 +334,14 @@ class SurveyScheduler:
         if survey_id is None:
             survey_id = survey_identity([f for c in self.chunks for f in c])
         self.survey_id = survey_id
+        # Live-status state: the chunk currently dispatched (None
+        # between chunks) and this run's journaled timing blocks (the
+        # ledger row derives from them, identically to how rreport
+        # re-derives it from the journal — so a run always compares
+        # equal against its own ledger row).
+        self._in_flight = None
+        self._run_timings = []
+        self._running = False
 
     # -- staging ------------------------------------------------------------
 
@@ -446,16 +468,94 @@ class SurveyScheduler:
         run re-dispatches it once the underlying fault clears."""
         log.warning("parking chunk %d: %s", chunk_id, reason)
         self.metrics.add("chunks_parked")
+        incidents.emit("chunk_parked", chunk_id=chunk_id,
+                       reason=str(reason))
         if self.journal is not None:
             self.journal.record_parked(chunk_id, reason,
                                        files=self.chunks[chunk_id])
+
+    # -- live status --------------------------------------------------------
+
+    def status(self):
+        """The live ``/status`` document of this survey (registered
+        with :func:`riptide_tpu.obs.prom.set_status_provider` while
+        ``RIPTIDE_STATUS`` is on, and the same numbers ``tools/rtop.py``
+        derives by tail-reading the journal): chunk progress, the EWMA
+        chunk rate and ETA, heartbeat ages, breaker state and the most
+        recent incident."""
+        m = self.metrics
+        done = int(m.counter("chunks_done") + m.counter("chunks_skipped"))
+        parked = int(m.counter("chunks_parked"))
+        total = len(self.chunks)
+        ewma = (self.watchdog.ewma.value
+                if self.watchdog is not None else None)
+        if ewma is None:
+            t = m.snapshot()["timers"].get("chunk_s")
+            if t and t["count"]:
+                ewma = t["total_s"] / t["count"]
+        remaining = max(0, total - done - parked)
+        status = {
+            "survey_id": self.survey_id,
+            # Gates /healthz: once the run finishes, heartbeats stop
+            # LEGITIMATELY — the probe must not page over a completed
+            # run's aging beats (the provider stays registered so this
+            # final state remains queryable).
+            "running": self._running,
+            "chunks_total": total,
+            "chunks_done": done,
+            "chunks_parked": parked,
+            "chunk_in_flight": self._in_flight,
+            "ewma_chunk_s": None if ewma is None else round(ewma, 4),
+            "rate_chunks_per_s": (None if not ewma
+                                  else round(1.0 / ewma, 4)),
+            "eta_s": None if ewma is None else round(remaining * ewma, 1),
+            "breaker": (self.breaker.state
+                        if self.breaker is not None else None),
+            "last_incident": incidents.last_incident(),
+        }
+        if self.journal is not None:
+            now = time.time()
+            status["heartbeat_age_s"] = {
+                str(p): round(max(0.0, now - ts), 3)
+                for p, ts in self.journal.read_heartbeats().items()
+            }
+        return status
 
     # -- main loop ----------------------------------------------------------
 
     def run(self):
         """Process every chunk; returns the flat Peak list in chunk
         order (journal-replayed and freshly-searched chunks interleave
-        exactly as an uninterrupted run would produce them)."""
+        exactly as an uninterrupted run would produce them).
+
+        For the run's duration the journal is installed as the
+        process-wide incident sink (so watchdog/breaker/OOM/quarantine/
+        peer-loss incidents emitted anywhere down-stack are journaled
+        with the chunk records) and — unless ``RIPTIDE_STATUS=0`` —
+        :meth:`status` is registered as the live ``/status`` source on
+        the Prometheus endpoint (the provider stays registered after
+        the run, so a final state remains queryable)."""
+        prev_sink = None
+        sink_set = False
+        # A fresh run's /status must not inherit the previous run's
+        # last_incident (one long-lived process can host many surveys).
+        incidents.clear_last()
+        if self.journal is not None:
+            prev_sink = incidents.set_sink(self.journal.record_incident)
+            sink_set = True
+        if envflags.get("RIPTIDE_STATUS"):
+            prom.set_status_provider(self.status)
+        self._running = True
+        try:
+            return self._run()
+        finally:
+            self._running = False
+            self._in_flight = None
+            if sink_set:
+                incidents.set_sink(prev_sink)
+
+    def _run(self):
+        t_run0 = time.perf_counter()
         done = {}
         if self.journal is not None:
             self.journal.write_header(self.survey_id, len(self.chunks))
@@ -503,9 +603,16 @@ class SurveyScheduler:
                     )
                 if self.monitor is not None:
                     self.monitor.beat()
+                elif self.journal is not None:
+                    # Single-process journaled runs heartbeat too: the
+                    # /healthz probe and rtop read beat age as THE
+                    # liveness signal of a run they cannot otherwise
+                    # observe.
+                    self.journal.heartbeat(0)
                 if self.breaker is not None and not self.breaker.allow():
                     self._park(cid, f"circuit {self.breaker.state}")
                     continue
+                self._in_flight = cid
                 t0 = time.perf_counter()
                 self.faults.corrupt_wire(cid, items)
                 try:
@@ -522,17 +629,20 @@ class SurveyScheduler:
                     self.breaker.record_failure()
                     self._park(cid, f"dispatch failed after retries: {err}")
                     continue
+                finally:
+                    self._in_flight = None
                 if self.breaker is not None:
                     self.breaker.record_success()
                 chunk_s = time.perf_counter() - t0
                 self.metrics.observe("chunk_s", chunk_s)
                 self.metrics.add("chunks_done")
                 peaks_by_chunk[cid] = peaks
+                timing = chunk_timing(chunk_s, prep_s=prep_s, **parts)
+                self._run_timings.append(timing)
                 if self.journal is not None:
                     dq = {}
                     if hasattr(self.searcher, "chunk_dq_summary"):
                         dq = self.searcher.chunk_dq_summary(self.chunks[cid])
-                    timing = chunk_timing(chunk_s, prep_s=prep_s, **parts)
                     with span("journal", chunk=cid):
                         self.journal.record_chunk(
                             cid, self.chunks[cid],
@@ -547,8 +657,30 @@ class SurveyScheduler:
         if self.journal is not None:
             self.journal.record_metrics(self.metrics.summary())
             # One Perfetto-loadable trace file per run, next to the
-            # journal (no-op while tracing is disabled).
+            # journal (no-op while tracing is disabled; a resumed run's
+            # fresh tracer rotates the killed attempt's file to
+            # trace.json.1 instead of overwriting it).
             export_run_trace(self.journal.directory)
         prom.maybe_write_textfile(self.metrics)
+        if self._run_timings:
+            # One perf-ledger row per run (no-op unless RIPTIDE_LEDGER
+            # is set), derived from the journaled chunk timings by the
+            # same reduction rreport applies to the journal.
+            from ..obs import ledger
+            from ..obs.report import run_decomposition_from_chunks
+
+            run_dec, nchunks, bound_counts = \
+                run_decomposition_from_chunks(self._run_timings)
+            ledger.maybe_append(
+                "survey", run_dec, nchunks=nchunks,
+                bound_counts=bound_counts,
+                extra={
+                    "survey_id": self.survey_id,
+                    "chunks_total": len(self.chunks),
+                    "chunks_parked":
+                        int(self.metrics.counter("chunks_parked")),
+                    "elapsed_s": round(time.perf_counter() - t_run0, 3),
+                },
+            )
         return [p for cid in sorted(peaks_by_chunk)
                 for p in peaks_by_chunk[cid]]

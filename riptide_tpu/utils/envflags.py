@@ -166,6 +166,31 @@ _ALL = [
         since="PR 8 (0.8.0)",
     ),
     EnvFlag(
+        "RIPTIDE_LEDGER", "str", None,
+        "Path of the append-only JSONL performance ledger: every "
+        "bench.py / tools/stime.py / journaled-survey run appends ONE "
+        "run record (phase decomposition, git sha, envflag "
+        "fingerprint, device platform, KERNEL_CACHE_VERSION, per-chunk "
+        "bound counts). `tools/rreport.py --compare` reads it as the "
+        "regression baseline. Unset disables.",
+        since="PR 9 (0.9.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_STATUS", "bool", True,
+        "Publish the live survey status surface: journaled survey runs "
+        "register a /status + /healthz source on the Prometheus "
+        "endpoint (RIPTIDE_PROM_PORT). `0` leaves the endpoint "
+        "metrics-only.",
+        since="PR 9 (0.9.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_STATUS_STALE_S", "float", 120.0,
+        "Heartbeat age (seconds) beyond which the /healthz probe "
+        "reports 503: a survey process whose freshest journal "
+        "heartbeat is older than this is up but not making progress.",
+        since="PR 9 (0.9.0)",
+    ),
+    EnvFlag(
         "RIPTIDE_BENCH_BUDGET", "float", 1380.0,
         "Total process wall-time budget (seconds) bench.py runs "
         "against: the first timed pass always emits a JSON line, "

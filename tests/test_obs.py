@@ -60,7 +60,8 @@ def test_span_set_and_error_attrs(tracer):
         with span("work", chunk=1) as s:
             s.set(files=3)
             raise ValueError("boom")
-    (name, _, _, _, attrs), = tracer.events()
+    (name, _, _, _, attrs, sid), = tracer.events()
+    assert isinstance(sid, int) and sid >= 1
     assert name == "work"
     assert attrs["files"] == 3
     assert attrs["error"] == "ValueError"
@@ -92,7 +93,7 @@ def test_tracer_thread_safety():
     # may be REUSED across joined threads, so lanes can coincide; what
     # must hold is one lane per worker and a complete count.)
     by_worker = {}
-    for _, _, _, tid, attrs in events:
+    for _, _, _, tid, attrs, _ in events:
         by_worker.setdefault(attrs["worker"], []).append(tid)
     assert set(by_worker) == set(range(8))
     for tids in by_worker.values():

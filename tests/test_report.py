@@ -1,0 +1,655 @@
+"""
+Signal-consumption layer tests (PR 9): the perf ledger (row schema,
+append discipline, envflag fingerprint), the jax-free report module
+(phase attribution, stragglers, tunnel stats, the noise-aware ledger
+comparison), structured incident records (sink install, span-id
+correlation, journal interop), the live /status + /healthz + 404 HTTP
+surface, trace-file rotation on resume, and forward/backward journal
+compatibility (pre-PR-9 journals report/resume/rtop cleanly).
+
+The heavier end-to-end path (live scraping DURING a run, the compare
+exit codes against a synthetic baseline) lives in tools/report_demo.py
+(`make report-demo`); these tests keep tier-1 coverage of every piece
+on tiny inputs.
+"""
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from riptide_tpu.obs import ledger, prom
+from riptide_tpu.obs import report as rep
+from riptide_tpu.obs.chrome import export_run_trace, rotate_trace_file
+from riptide_tpu.obs.schema import chunk_timing
+from riptide_tpu.obs.trace import Tracer, set_tracer, span
+from riptide_tpu.survey import incidents
+from riptide_tpu.survey.journal import SurveyJournal, _append_line
+from riptide_tpu.survey.metrics import get_metrics
+
+from synth import generate_data_presto
+
+TOOLS = os.path.normpath(
+    os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def _tool(name):
+    """Import a tools/ CLI module (rreport / rtop) the way operators
+    run them: standalone, jax-free."""
+    if TOOLS not in sys.path:
+        sys.path.insert(0, TOOLS)
+    return __import__(name)
+
+
+@pytest.fixture
+def tracer():
+    tr = Tracer(capacity=4096)
+    prev = set_tracer(tr)
+    yield tr
+    set_tracer(prev)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_sinks_or_providers():
+    """Incident sink, status provider and last-incident are
+    process-wide; clear them on BOTH sides of every test here (earlier
+    suite files run real schedulers, which by design leave their status
+    provider registered)."""
+    def _clear():
+        incidents.set_sink(None)
+        prom.set_status_provider(None)
+        incidents.clear_last()
+
+    _clear()
+    yield
+    _clear()
+
+
+def _timing(chunk_s=2.0, wire_s=0.5, queue_s=0.1, collect_s=1.3,
+            prep_s=0.4, device_s=1.2, wire_bytes=50_000_000):
+    return chunk_timing(chunk_s, prep_s=prep_s, wire_s=wire_s,
+                        queue_s=queue_s, device_s=device_s,
+                        collect_s=collect_s, wire_bytes=wire_bytes)
+
+
+# ------------------------------------------------------------------ ledger
+
+def test_ledger_row_schema_and_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.delenv("RIPTIDE_LEDGER", raising=False)
+    # Off by default: no path configured, no write, no error.
+    assert ledger.maybe_append("bench", {"device_s": 1.0}) is None
+
+    path = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("RIPTIDE_LEDGER", path)
+    dec = {"prep_s": 0.4, "wire_s": 0.5, "device_s": 1.2, "chunk_s": 2.0,
+           "wire_MBps": 100.0}
+    assert ledger.maybe_append(
+        "survey", dec, nchunks=4, bound_counts={"device": 3, "tunnel": 1},
+        extra={"survey_id": "abc"},
+    ) == path
+    rows = ledger.read_rows(path)
+    assert len(rows) == 1
+    row = rows[0]
+    # Decomposition keys verbatim + provenance block.
+    assert {k: row[k] for k in dec} == dec
+    assert row["kind"] == "survey" and row["v"] == ledger.LEDGER_VERSION
+    assert row["nchunks"] == 4
+    assert row["bound_counts"] == {"device": 3, "tunnel": 1}
+    assert row["survey_id"] == "abc"
+    assert row["utc"].endswith("Z") and "T" in row["utc"]
+    assert row["git_sha"]  # we run from a checkout
+    assert isinstance(row["envflags_fingerprint"], str)
+    assert "backend" in row["platform"]
+    assert isinstance(row["kernel_cache_version"], int)
+
+    # Appends accumulate; a torn tail line is dropped, not fatal.
+    ledger.maybe_append("bench", dec, nchunks=1,
+                        bound_counts={"device": 1})
+    with open(path, "a") as fobj:
+        fobj.write('{"kind": "torn')
+    rows = ledger.read_rows(path)
+    assert [r["kind"] for r in rows] == ["survey", "bench"]
+    # The standalone reader applies the same tolerance.
+    assert [r["kind"] for r in rep.read_ledger(path)] == ["survey", "bench"]
+
+
+def test_envflag_fingerprint_tracks_non_defaults(monkeypatch):
+    monkeypatch.delenv("RIPTIDE_TRACE_RING", raising=False)
+    fp0, flags0 = ledger.envflag_fingerprint()
+    assert "RIPTIDE_TRACE_RING" not in flags0
+    monkeypatch.setenv("RIPTIDE_TRACE_RING", "123")
+    fp1, flags1 = ledger.envflag_fingerprint()
+    assert flags1["RIPTIDE_TRACE_RING"] == 123
+    assert fp1 != fp0
+    # An unparsable value is recorded, never raised.
+    monkeypatch.setenv("RIPTIDE_TRACE_RING", "not-an-int")
+    _, flags2 = ledger.envflag_fingerprint()
+    assert "unparsable" in str(flags2["RIPTIDE_TRACE_RING"])
+
+
+def test_envflag_fingerprint_ignores_recording_flags(monkeypatch):
+    """RIPTIDE_LEDGER is non-default in EVERY row (rows only exist
+    while it is set): recording-only flags must not make two
+    perf-identical runs fingerprint as different regimes."""
+    monkeypatch.delenv("RIPTIDE_TRACE_RING", raising=False)
+    for name in ledger.FINGERPRINT_EXCLUDE:
+        monkeypatch.delenv(name, raising=False)
+    fp0, _ = ledger.envflag_fingerprint()
+    monkeypatch.setenv("RIPTIDE_LEDGER", "/somewhere/else.jsonl")
+    monkeypatch.setenv("RIPTIDE_PROM_PORT", "9109")
+    monkeypatch.setenv("RIPTIDE_STATUS_STALE_S", "5")
+    fp1, flags = ledger.envflag_fingerprint()
+    assert fp1 == fp0
+    assert not set(flags) & ledger.FINGERPRINT_EXCLUDE
+
+
+# ------------------------------------------------------------------ report
+
+def test_read_journal_families_and_last_record_wins(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("sid", 3)
+    j.record_chunk(0, ["a.inf"], [0.0], [], timings=_timing(),
+                   attempts=1)
+    # Chunk 1 parked first, then completed on a later attempt: the
+    # completion must erase the park for every reader.
+    j.record_parked(1, "circuit open", files=["b.inf"])
+    j.record_chunk(1, ["b.inf"], [5.0], [], timings=_timing(3.0),
+                   attempts=2)
+    j.record_parked(2, "dispatch failed", files=["c.inf"])
+    j.record_incident({"incident": "breaker_open", "detail": {"x": 1}})
+    j.record_metrics({"chunks_done": 2})
+    # A retried chunk's final journaling wins.
+    j.record_chunk(0, ["a.inf"], [0.0], [], timings=_timing(9.0),
+                   attempts=3)
+
+    doc = rep.read_journal(str(tmp_path / "j"))
+    assert doc["header"]["survey_id"] == "sid"
+    assert sorted(doc["chunks"]) == [0, 1]
+    assert doc["chunks"][0]["attempts"] == 3
+    assert list(doc["parked"]) == [2]
+    assert doc["parked"][2]["reason"] == "dispatch failed"
+    assert [i["incident"] for i in doc["incidents"]] == ["breaker_open"]
+    assert doc["metrics"] == {"chunks_done": 2}
+    # The journal's own reader agrees.
+    assert [i["incident"] for i in j.incidents()] == ["breaker_open"]
+
+
+def test_phase_attribution_sums_and_flags_violations():
+    good = {cid: {"timings": _timing(2.0)} for cid in range(3)}
+    rows, violations = rep.phase_attribution(good)
+    assert not violations
+    # chunk_timing constructs host_s as the serial remainder, so the
+    # serial rows reconstruct total wall-clock exactly.
+    serial_total = sum(t for p, t, _ in rows if p in rep.SERIAL_PHASES)
+    assert serial_total == pytest.approx(6.0, rel=1e-6)
+    assert rows[-1][0] == "prep (overlapped)" and rows[-1][2] is None
+
+    bad = dict(good)
+    broken = dict(_timing(2.0), collect_s=0.0)  # no longer sums
+    bad[9] = {"timings": broken}
+    _, violations = rep.phase_attribution(bad)
+    assert [v["chunk_id"] for v in violations] == [9]
+
+
+def test_stragglers_and_tunnel_stats():
+    chunks = {cid: {"timings": _timing(1.0, collect_s=0.3)}
+              for cid in range(5)}
+    chunks[7] = {"timings": _timing(10.0, collect_s=9.3)}
+    out = rep.stragglers(chunks)
+    assert [cid for cid, _, _ in out] == [7]
+    assert out[0][2] > 5
+
+    tun = rep.tunnel_stats(chunks)
+    assert tun["n_rates"] == 6
+    assert tun["bound_counts"]["device"] == 6
+    assert tun["wire_MBps_min"] <= tun["wire_MBps_median"] \
+        <= tun["wire_MBps_max"]
+    assert tun["chunks_below_knee"] == 0
+
+
+def test_compare_to_ledger_verdicts():
+    def row(dev_per_chunk, bound="device", n=4):
+        return {"device_s": dev_per_chunk * n, "nchunks": n,
+                "bound_counts": {bound: n}}
+
+    base = [row(1.0), row(1.1), row(0.9), row(50.0, bound="tunnel")]
+
+    v, rc = rep.compare_to_ledger(row(1.0), base)
+    assert rc == 0 and v["verdict"] == "ok"
+    # Tunnel-weather history is excluded from the baseline.
+    assert v["baseline_n"] == 3 and v["excluded_tunnel_rows"] == 1
+    assert v["baseline_median"] == pytest.approx(1.0)
+    assert v["threshold"] == pytest.approx(
+        1.0 * 1.15 + 3.0 * 0.1)  # median*(1+tol) + k*MAD
+
+    v, rc = rep.compare_to_ledger(row(4.0), base)
+    assert rc == 1 and v["verdict"] == "regression"
+    assert v["ratio"] == pytest.approx(4.0)
+
+    # A tunnel-bound current run is never judged on device time.
+    v, rc = rep.compare_to_ledger(row(4.0, bound="tunnel"), base)
+    assert rc == 0 and v["verdict"] == "skipped-tunnel"
+    # No usable history -> no verdict, exit 0.
+    v, rc = rep.compare_to_ledger(row(1.0), [row(1.0, bound="tunnel")])
+    assert rc == 0 and v["verdict"] == "no-baseline"
+    v, rc = rep.compare_to_ledger({"nchunks": 4}, base)
+    assert rc == 0 and v["verdict"] == "no-data"
+
+
+def test_compare_scopes_baseline_by_kind_and_platform():
+    """A shared ledger mixes kinds and platforms; rows of the wrong
+    kind or platform must never enter the baseline (a cpu smoke row
+    cannot baseline a TPU regression check)."""
+    def row(dev, kind="survey", backend="tpu", device_kind="TPU v4"):
+        return {"kind": kind, "device_s": dev * 4, "nchunks": 4,
+                "bound_counts": {"device": 4},
+                "platform": {"backend": backend,
+                             "device_kind": device_kind}}
+
+    tpu = {"backend": "tpu", "device_kind": "TPU v4"}
+    # History: comparable TPU survey rows at ~1 s/chunk, plus a bench
+    # row and 100x-slower cpu rows that would wreck the band.
+    rows = [row(1.0), row(1.1), row(0.9),
+            row(5.0, kind="bench"),
+            row(100.0, backend="cpu", device_kind="cpu"),
+            row(110.0, backend="cpu", device_kind="cpu")]
+
+    # Unscoped, the cpu rows inflate the median and a 4x regression
+    # sails through — the failure mode the scoping exists to prevent.
+    v, rc = rep.compare_to_ledger(row(4.0), rows)
+    assert rc == 0 and v["verdict"] == "ok"
+    v, rc = rep.compare_to_ledger(row(4.0), rows, kind="survey",
+                                  platform=tpu)
+    assert rc == 1 and v["verdict"] == "regression"
+    assert v["baseline_n"] == 3 and v["excluded_scope_rows"] == 3
+
+    # latest_platform: newest row carrying a platform, per kind.
+    assert rep.latest_platform(rows) == {"backend": "cpu",
+                                         "device_kind": "cpu"}
+    assert rep.latest_platform(rows, kind="bench") == tpu
+    assert rep.latest_platform([{"kind": "survey"}]) is None
+
+
+def test_drop_own_row_drops_only_newest_match():
+    """The run's just-appended row leaves the baseline, but a nightly
+    re-run of the SAME survey (same survey_id) keeps all its history."""
+    rows = [{"survey_id": "s", "device_s": 1.0},
+            {"survey_id": "other", "device_s": 2.0},
+            {"survey_id": "s", "device_s": 3.0}]
+    kept, dropped = rep.drop_own_row(rows, "s")
+    assert dropped
+    assert [r["device_s"] for r in kept] == [1.0, 2.0]
+    kept, dropped = rep.drop_own_row(rows, "absent")
+    assert not dropped and len(kept) == 3
+    kept, dropped = rep.drop_own_row(rows, None)
+    assert not dropped and len(kept) == 3
+
+
+def test_run_decomposition_matches_scheduler_derivation():
+    timings = [_timing(2.0), _timing(4.0)]
+    run, n, bounds = rep.run_decomposition_from_chunks(timings)
+    assert n == 2 and bounds == {"device": 2}
+    assert run["chunk_s"] == pytest.approx(3.0)
+    assert run["wire_s"] == pytest.approx(1.0)
+    # Empty and None-holed inputs stay well-defined.
+    run0, n0, b0 = rep.run_decomposition_from_chunks([None, {}])
+    assert n0 == 0 and b0 == {} and run0["wire_MBps"] is None
+
+
+def test_journal_follower_incremental_and_torn_tail(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("sid", 3)
+    j.record_chunk(0, ["a.inf"], [0.0], [], timings=_timing())
+
+    follower = rep.JournalFollower(str(tmp_path / "j"))
+    doc = follower.poll()
+    assert sorted(doc["chunks"]) == [0]
+
+    # Appends between polls are folded incrementally; a torn tail line
+    # (a writer killed mid-append) is invisible until completed.
+    j.record_incident({"incident": "breaker_open"})
+    with open(j.journal_path, "a") as fobj:
+        fobj.write('{"kind": "chunk", "chunk_id": 1')
+    doc = follower.poll()
+    assert sorted(doc["chunks"]) == [0]
+    assert [i["incident"] for i in doc["incidents"]] == ["breaker_open"]
+    with open(j.journal_path, "a") as fobj:
+        fobj.write(', "attempts": 1}\n')
+    doc = follower.poll()
+    assert sorted(doc["chunks"]) == [0, 1]
+    # Idempotent when nothing new arrived (no re-reading, no dupes).
+    doc = follower.poll()
+    assert len(doc["incidents"]) == 1
+
+    # The one-shot reader agrees with the followed state.
+    assert rep.read_journal(str(tmp_path / "j"))["chunks"].keys() \
+        == doc["chunks"].keys()
+
+    # A replaced (shrunken) journal resets the follower.
+    with open(j.journal_path, "w") as fobj:
+        fobj.write('{"kind": "header", "survey_id": "new"}\n')
+    doc = follower.poll()
+    assert doc["header"]["survey_id"] == "new" and not doc["chunks"]
+
+
+# --------------------------------------------------------------- incidents
+
+def test_incident_emit_without_sink_counts_and_retains():
+    get_metrics().reset()
+    rec = incidents.emit("quarantine", chunk_id=3, fname="x.inf",
+                        masked_frac=0.5, reasons=("nan", "clip"))
+    assert rec["kind"] == "incident"
+    assert rec["incident"] == "quarantine"
+    assert rec["chunk_id"] == 3
+    assert rec["utc"].endswith("Z")
+    assert "span_id" not in rec  # tracing disabled suite-wide
+    # Detail values are JSON-safe (the tuple became a list).
+    assert rec["detail"]["reasons"] == ["nan", "clip"]
+    assert json.dumps(rec)
+    assert incidents.last_incident() is rec
+    assert get_metrics().snapshot()["counters"]["incidents"] == 1
+    # A fresh run clears the retained incident (the scheduler calls
+    # this at run start, so /status never shows a previous run's).
+    incidents.clear_last()
+    assert incidents.last_incident() is None
+
+
+def test_incident_sink_journal_and_span_id(tmp_path, tracer):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("sid", 1)
+    prev = incidents.set_sink(j.record_incident)
+    try:
+        with span("dispatch", chunk=0):
+            rec = incidents.emit("watchdog_timeout", chunk_id=0,
+                                 budget_s=1.5)
+    finally:
+        incidents.set_sink(prev)
+    # The incident carries the id of the span open when it fired, and
+    # the exported trace labels that span with the same id.
+    assert isinstance(rec["span_id"], int)
+    (_, _, _, _, _, sid), = tracer.events()
+    assert rec["span_id"] == sid
+    stored, = j.incidents()
+    assert stored["incident"] == "watchdog_timeout"
+    assert stored["span_id"] == sid
+    # Incident lines are invisible to the resume reader.
+    assert SurveyJournal(tmp_path / "j").completed_chunks() == {}
+
+    # A failing sink is logged, never raised.
+    incidents.set_sink(lambda rec: (_ for _ in ()).throw(OSError("disk")))
+    try:
+        incidents.emit("breaker_open")
+    finally:
+        incidents.set_sink(None)
+
+
+# ------------------------------------------------- /status + /healthz + 404
+
+def test_status_snapshot_and_health_check(monkeypatch):
+    prom.set_status_provider(None)
+    assert prom.status_snapshot() == {"active": False}
+    ok, problems = prom.health_check()
+    assert ok and not problems  # no survey running != unhealthy
+
+    prom.set_status_provider(lambda: {"breaker": "open",
+                                      "chunks_done": 1})
+    snap = prom.status_snapshot()
+    assert snap["active"] is True and snap["chunks_done"] == 1
+    ok, problems = prom.health_check()
+    assert not ok and problems == ["circuit breaker open"]
+
+    monkeypatch.setenv("RIPTIDE_STATUS_STALE_S", "10")
+    prom.set_status_provider(
+        lambda: {"heartbeat_age_s": {"0": 999.0, "1": 3.0}})
+    # The FRESHEST beat decides: one live process keeps the run alive.
+    ok, _ = prom.health_check()
+    assert ok
+    prom.set_status_provider(lambda: {"heartbeat_age_s": {"0": 999.0}})
+    ok, problems = prom.health_check()
+    assert not ok and "stale heartbeat" in problems[0]
+
+    # A FINISHED run (running=false) is healthy whatever its final
+    # breaker state or heartbeat ages: the probe answers "is the run
+    # wedged", and a supervisor must never kill an idle process over a
+    # completed run's aging beats.
+    prom.set_status_provider(
+        lambda: {"running": False, "breaker": "open",
+                 "heartbeat_age_s": {"0": 999.0}})
+    ok, problems = prom.health_check()
+    assert ok and not problems
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def test_http_status_healthz_and_404(monkeypatch):
+    get_metrics().reset()
+    server = prom.serve(0)
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        code, body = _get(f"{base}/status")
+        assert code == 200 and json.loads(body) == {"active": False}
+        code, body = _get(f"{base}/healthz")
+        assert code == 200 and json.loads(body)["ok"] is True
+
+        prom.set_status_provider(lambda: {
+            "survey_id": "sid", "chunks_done": 1, "breaker": "open"})
+        code, body = _get(f"{base}/status")
+        doc = json.loads(body)
+        assert code == 200 and doc["active"] and doc["chunks_done"] == 1
+        code, body = _get(f"{base}/healthz")
+        doc = json.loads(body)
+        assert code == 503
+        assert doc["ok"] is False
+        assert "circuit breaker open" in doc["problems"]
+
+        # Unknown paths: 404 whose body names every valid endpoint.
+        code, body = _get(f"{base}/metricz")
+        assert code == 404
+        for endpoint in prom.ENDPOINTS:
+            assert endpoint in body
+    finally:
+        server.close()
+
+
+# -------------------------------------------------------- trace rotation
+
+def test_rotate_trace_file_bounded_depth(tmp_path):
+    path = str(tmp_path / "trace.json")
+    for gen in range(5):
+        with open(path, "w") as fobj:
+            fobj.write(f"gen{gen}")
+        rotate_trace_file(path)
+        assert not os.path.exists(path)
+    # Newest prior at .1, bounded at depth 3: gen0/gen1 fell off.
+    kept = {i: open(f"{path}.{i}").read() for i in (1, 2, 3)}
+    assert kept == {1: "gen4", 2: "gen3", 3: "gen2"}
+    assert not os.path.exists(f"{path}.4")
+    rotate_trace_file(str(tmp_path / "absent.json"))  # no-op
+
+
+def test_export_rotates_for_fresh_tracer_only(tmp_path, tracer):
+    with span("first"):
+        pass
+    path = os.path.join(str(tmp_path), "trace.json")
+    export_run_trace(str(tmp_path))
+    # Same-run re-export (scheduler end-of-search, then rffa post-stage)
+    # overwrites in place: no rotation.
+    export_run_trace(str(tmp_path))
+    assert os.path.exists(path) and not os.path.exists(path + ".1")
+
+    # A fresh tracer (a resumed run in a new process) rotates first.
+    fresh = Tracer(capacity=64)
+    prev = set_tracer(fresh)
+    try:
+        with span("second"):
+            pass
+        export_run_trace(str(tmp_path))
+    finally:
+        set_tracer(prev)
+    names = lambda p: {e["name"] for e in json.load(open(p))["traceEvents"]
+                       if e["ph"] == "X"}
+    assert names(path) == {"second"}
+    assert names(path + ".1") == {"first"}
+
+
+# -------------------------------------- survey e2e: resume, status, ledger
+
+TOBS, TSAMP, PERIOD = 16.0, 1e-3, 0.5
+
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+
+def _searcher():
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+
+    return BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                         SEARCH_CONF, fmt="presto", io_threads=1)
+
+
+def test_survey_resume_preserves_prior_trace_and_ledgers(tmp_path,
+                                                         monkeypatch):
+    """The satellite fix end-to-end: attempt 1 of a journaled survey
+    exports trace.json; a resumed attempt (fresh process = fresh
+    tracer) must rotate it to trace.json.1 — BOTH files survive — and
+    the completed run appends a ledger row + a live status document."""
+    from riptide_tpu.survey.scheduler import SurveyScheduler
+
+    f1 = generate_data_presto(str(tmp_path), "a_DM0.00", tobs=TOBS,
+                              tsamp=TSAMP, period=PERIOD, dm=0.0)
+    f2 = generate_data_presto(str(tmp_path), "b_DM5.00", tobs=TOBS,
+                              tsamp=TSAMP, period=PERIOD, dm=5.0)
+    jdir = str(tmp_path / "j")
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("RIPTIDE_LEDGER", ledger_path)
+    trace_path = os.path.join(jdir, "trace.json")
+
+    # Attempt 1 (its own tracer, standing in for its own process).
+    tr1 = Tracer(capacity=4096)
+    prev = set_tracer(tr1)
+    try:
+        get_metrics().reset()
+        SurveyScheduler(_searcher(), [[f1], [f2]],
+                        journal=SurveyJournal(jdir)).run()
+    finally:
+        set_tracer(prev)
+    assert os.path.exists(trace_path)
+    assert not os.path.exists(trace_path + ".1")
+
+    # Resume in a "fresh process": prior trace must survive rotation.
+    tr2 = Tracer(capacity=4096)
+    prev = set_tracer(tr2)
+    try:
+        get_metrics().reset()
+        sched = SurveyScheduler(_searcher(), [[f1], [f2]],
+                                journal=SurveyJournal(jdir), resume=True)
+        peaks = sched.run()
+    finally:
+        set_tracer(prev)
+    assert peaks
+    assert os.path.exists(trace_path)
+    assert os.path.exists(trace_path + ".1")
+    with open(trace_path + ".1") as fobj:
+        prior = json.load(fobj)
+    # The rotated file is attempt 1's full trace (real dispatch spans).
+    assert any(e.get("name") == "dispatch"
+               for e in prior["traceEvents"])
+
+    # Status document of the finished run.
+    st = sched.status()
+    assert st["chunks_total"] == 2
+    assert st["chunks_done"] == 2 and st["chunks_parked"] == 0
+    assert st["chunk_in_flight"] is None
+    assert st["breaker"] is None and st["last_incident"] is None
+    assert st["heartbeat_age_s"]  # single-process journaled runs beat
+    assert os.path.exists(os.path.join(jdir, "heartbeat_0000.jsonl"))
+    # The finished run stays healthy however stale its (legitimately
+    # stopped) heartbeats get.
+    assert st["running"] is False
+    ok, problems = prom.health_check(st, stale_s=1e-9)
+    assert ok and not problems
+
+    # Ledger: attempt 1 recorded both chunks; the resume run replayed
+    # them (no fresh timings), so exactly one survey row exists — and
+    # rreport --compare against it exits 0 (a run equals its own row).
+    rows = ledger.read_rows(ledger_path)
+    assert len(rows) == 1
+    assert rows[0]["kind"] == "survey" and rows[0]["nchunks"] == 2
+    assert sum(rows[0]["bound_counts"].values()) == 2
+    rreport = _tool("rreport")
+    assert rreport.main([jdir, "--quiet"]) == 0
+    assert rreport.main([jdir, "--compare", ledger_path, "--quiet"]) == 0
+
+
+# ---------------------------------------------- pre-PR-9 journal compat
+
+def _write_pre_pr9_journal(tmp_path):
+    """A journal as PR <= 7 code wrote it: chunk records without utc,
+    timings, dq or incident lines (and no heartbeat sidecars)."""
+    j = SurveyJournal(tmp_path / "old")
+    _append_line(j.journal_path, {
+        "kind": "header", "version": 1, "survey_id": "oldsurvey",
+        "chunks_total": 2,
+    })
+    for cid in range(2):
+        _append_line(j.journal_path, {
+            "kind": "chunk", "chunk_id": cid, "files": [f"{cid}.inf"],
+            "dms": [float(cid)], "wire_digest": None,
+            "peaks_offset": 0, "peaks_count": 0, "attempts": 1,
+        })
+    return str(tmp_path / "old")
+
+
+def test_pre_pr9_journal_resumes_reports_and_rtops(tmp_path, capsys):
+    jdir = _write_pre_pr9_journal(tmp_path)
+
+    # Resume reader: both chunks count as completed, nothing raises.
+    done = SurveyJournal(jdir).completed_chunks()
+    assert sorted(done) == [0, 1]
+    assert SurveyJournal(jdir).incidents() == []
+
+    # Report: empty timings/incidents degrade to zero rows, not errors.
+    report = rep.build_report(jdir)
+    assert report["chunks_done"] == 2 and report["incidents"] == []
+    assert not report["phase_sum_violations"]
+    assert report["run"]["nchunks"] == 0  # no timing blocks to reduce
+    text = rep.render_text(report)
+    assert "oldsurvey" in text
+
+    # The CLIs over the same directory: rreport exits 0, rtop renders.
+    rreport, rtop = _tool("rreport"), _tool("rtop")
+    assert rreport.main([jdir, "--quiet"]) == 0
+    frame = rtop.render_frame(rreport.load_report_module(), jdir)
+    assert "chunks 2/2" in frame and "incidents" not in frame
+    capsys.readouterr()
+
+
+def test_rreport_cli_errors_and_json(tmp_path):
+    rreport = _tool("rreport")
+    # No journal: usage error, exit 2.
+    assert rreport.main([str(tmp_path / "nope"), "--quiet"]) == 2
+    assert rreport.main([_write_pre_pr9_journal(tmp_path), "--quiet",
+                         "--compare", str(tmp_path / "missing.jsonl")]) == 2
+
+    # A journal whose phases cannot reconstruct chunk_s exits 1.
+    j = SurveyJournal(tmp_path / "broken")
+    j.write_header("sid", 1)
+    bad = dict(_timing(4.0), collect_s=0.0)
+    j.record_chunk(0, ["a.inf"], [0.0], [], timings=bad)
+    out_json = str(tmp_path / "report.json")
+    assert rreport.main([str(tmp_path / "broken"), "--quiet",
+                         "--json", out_json]) == 1
+    with open(out_json) as fobj:
+        doc = json.load(fobj)
+    assert doc["phase_sum_violations"]

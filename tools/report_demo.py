@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""
+report-demo: the end-to-end acceptance path of the signal-CONSUMPTION
+layer (extends tools/trace_demo.py, which verifies the emission side).
+
+Runs a tiny CPU survey through the checkpointed scheduler with the
+span tracer, perf ledger and live HTTP endpoint all on, then verifies:
+
+* ``/status`` and ``/healthz`` answered live JSON DURING the run (a
+  poller thread scrapes them while chunks dispatch); after the run
+  ``/healthz`` stays 200 however stale the beats (running=false gates
+  the probe), but flips to 503 for a wedged RUNNING survey (simulated:
+  real scheduler status with running forced on, against a tightened
+  ``RIPTIDE_STATUS_STALE_S``);
+* unknown endpoint paths get a 404 naming the valid endpoints;
+* ``rreport`` over the journal exits 0 with a phase-attribution table
+  whose serial phases sum to the chunk wall-clock within 5%;
+* the scheduler appended one ``survey`` row to the ledger
+  (``RIPTIDE_LEDGER``), and ``rreport --compare`` exits 0 against that
+  own row but NONZERO against a synthetic baseline in which history
+  was 4x faster (i.e. the current run's device time is inflated 4x
+  relative to baseline — the regression CI must catch);
+* ``rtop --once`` renders a progress frame from the same files.
+
+Output directory: /tmp/riptide_report_demo (or argv[1]). ``make
+report-demo`` runs this; it doubles as the CI smoke test of the whole
+obs consumption path.
+"""
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+TOBS, TSAMP, PERIOD = 16.0, 1e-3, 0.5
+
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+
+def _get(url, timeout=5.0):
+    """(HTTP status, body text) — 4xx/5xx included, not raised."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def main(outdir="/tmp/riptide_report_demo"):
+    from synth import generate_data_presto
+
+    from riptide_tpu.obs import prom, trace
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+    from riptide_tpu.survey.journal import SurveyJournal
+    from riptide_tpu.survey.metrics import get_metrics
+    from riptide_tpu.survey.scheduler import SurveyScheduler
+
+    shutil.rmtree(outdir, ignore_errors=True)
+    os.makedirs(outdir)
+    ledger_path = os.path.join(outdir, "ledger.jsonl")
+    os.environ["RIPTIDE_LEDGER"] = ledger_path
+    files = [
+        generate_data_presto(outdir, f"demo_DM{dm:.2f}", tobs=TOBS,
+                             tsamp=TSAMP, period=PERIOD, dm=dm,
+                             amplitude=25.0)
+        for dm in (0.0, 5.0)
+    ]
+
+    trace.enable()
+    get_metrics().reset()
+    jdir = os.path.join(outdir, "j")
+
+    # Live endpoint on an ephemeral port; the scheduler registers the
+    # /status provider itself (RIPTIDE_STATUS defaults on).
+    server = prom.serve(0)
+    base = f"http://127.0.0.1:{server.port}"
+    seen = {"status": [], "healthz": []}
+    stop = threading.Event()
+
+    def poller():
+        while not stop.wait(0.05):
+            code, body = _get(f"{base}/status")
+            if code == 200:
+                doc = json.loads(body)
+                if doc.get("active"):
+                    seen["status"].append(doc)
+            code, _ = _get(f"{base}/healthz")
+            seen["healthz"].append(code)
+
+    watcher = threading.Thread(target=poller, daemon=True)
+    watcher.start()
+
+    searcher = BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                             SEARCH_CONF, fmt="presto", io_threads=1)
+    scheduler = SurveyScheduler(searcher, [[f] for f in files],
+                                journal=SurveyJournal(jdir))
+    peaks = scheduler.run()
+    stop.set()
+    watcher.join(timeout=5.0)
+
+    # -- live surface -------------------------------------------------
+    assert seen["status"], "poller never saw a live /status during the run"
+    live = seen["status"][-1]
+    assert live["chunks_total"] == 2, live
+    assert "heartbeat_age_s" in live, live
+    assert 200 in seen["healthz"], "healthz never healthy during the run"
+    code, body = _get(f"{base}/status")
+    final = json.loads(body)
+    assert code == 200 and final["chunks_done"] == 2, (code, final)
+    # A FINISHED run's aging heartbeats must not page: healthz stays
+    # 200 however stale the beats, because status says running=false.
+    os.environ["RIPTIDE_STATUS_STALE_S"] = "0.2"
+    time.sleep(0.45)
+    code, _ = _get(f"{base}/healthz")
+    assert code == 200, f"healthz paged over a COMPLETED run: {code}"
+
+    # Stale heartbeats on a RUNNING survey flip the probe to 503:
+    # simulate a wedged in-flight run (real scheduler status — live
+    # heartbeat ages from the journal — with running forced back on).
+    sched = scheduler
+    prom.set_status_provider(lambda: dict(sched.status(), running=True))
+    code, body = _get(f"{base}/healthz")
+    assert code == 503, f"healthz did not flip on stale heartbeats: {code}"
+    assert "stale heartbeat" in body, body
+    prom.set_status_provider(sched.status)
+    del os.environ["RIPTIDE_STATUS_STALE_S"]
+
+    # Unknown paths are a 404 naming the valid endpoints.
+    code, body = _get(f"{base}/nope")
+    assert code == 404 and "/healthz" in body and "/metrics" in body, \
+        (code, body)
+
+    # -- rreport over the journal -------------------------------------
+    import rreport
+    import rtop
+
+    report_json = os.path.join(outdir, "report.json")
+    rc = rreport.main([jdir, "--json", report_json])
+    assert rc == 0, f"rreport exited {rc} on a clean journal"
+    with open(report_json) as fobj:
+        report = json.load(fobj)
+    assert not report["phase_sum_violations"], report["phase_sum_violations"]
+    # Re-verify the 5% phase-sum bound from the raw journal, not just
+    # rreport's own bookkeeping.
+    with open(os.path.join(jdir, "journal.jsonl")) as fobj:
+        chunks = [json.loads(l) for l in fobj if '"kind":"chunk"' in l]
+    assert len(chunks) == 2
+    for rec in chunks:
+        t = rec["timings"]
+        serial = t["wire_s"] + t["queue_s"] + t["collect_s"] + t["host_s"]
+        assert abs(serial - t["chunk_s"]) <= 0.05 * max(t["chunk_s"], 1e-9)
+
+    # -- ledger + regression sentinel ---------------------------------
+    with open(ledger_path) as fobj:
+        rows = [json.loads(l) for l in fobj if l.strip()]
+    assert len(rows) == 1 and rows[0]["kind"] == "survey", rows
+    row = rows[0]
+    assert row["nchunks"] == 2 and "device_s" in row \
+        and "envflags_fingerprint" in row and "platform" in row, row
+
+    # The run's own just-appended row is dropped from the baseline
+    # (comparing a run against itself would always say "ok"): with no
+    # other history the verdict is no-baseline, exit 0.
+    rc = rreport.main([jdir, "--compare", ledger_path, "--quiet"])
+    assert rc == 0, f"compare vs the run's own ledger row exited {rc}"
+
+    # Synthetic baseline: history (a previous round's survey) 4x
+    # faster, so the current run's device time is inflated 4x relative
+    # to it — a regression the sentinel must flag with a nonzero exit.
+    fast = dict(row, device_s=row["device_s"] / 4.0,
+                survey_id="previous-round")
+    fast_ledger = os.path.join(outdir, "ledger_fast_baseline.jsonl")
+    with open(fast_ledger, "w") as fobj:
+        fobj.write(json.dumps(fast) + "\n")
+    rc = rreport.main([jdir, "--compare", fast_ledger, "--quiet"])
+    assert rc == 1, f"compare vs a 4x-faster baseline exited {rc}, not 1"
+
+    # -- rtop over the same files -------------------------------------
+    rep = rreport.load_report_module()
+    frame = rtop.render_frame(rep, jdir)
+    assert "chunks 2/2" in frame, frame
+
+    server.close()
+    print(f"\nreport demo OK: {len(peaks)} peaks from {len(chunks)} chunks")
+    print(f"  journal   ->  {jdir}")
+    print(f"  ledger    ->  {ledger_path} (1 survey row)")
+    print(f"  report    ->  {report_json}")
+    print("  live /status + /healthz verified during the run; healthz "
+          "flipped to 503 on a wedged run's stale heartbeats\n"
+          "  (and stayed 200 for the completed run);")
+    print("  rreport --compare: 0 vs own row (excluded from its own "
+          "baseline), 1 vs 4x-faster prior round")
+    sys.stdout.write("\n" + frame)
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:2])

@@ -158,10 +158,25 @@ def main(D=32, CHUNKS=4):
             "metric": "stime_decomposition",
             "trials_per_sec": round(D * CHUNKS / dt, 3),
         }
-        block.update(decomposition(s, CHUNKS, dt))
+        sub = decomposition(s, CHUNKS, dt)
+        block.update(sub)
         block.update({k: v for k, v in s.items()
                       if k.startswith("dispatch_")})
         print(json.dumps(block), flush=True)
+
+        # One perf-ledger row per stime run (no-op unless RIPTIDE_LEDGER
+        # is set) — stime has no per-chunk timing records, so the
+        # run-level bound classification stands in for the counts.
+        from riptide_tpu.obs import ledger
+        from riptide_tpu.obs.schema import classify_bound
+
+        bound = classify_bound(sub.get("wire_s") or 0.0,
+                               sub.get("device_s") or 0.0)
+        ledger.maybe_append(
+            "stime", sub, nchunks=CHUNKS, bound_counts={bound: CHUNKS},
+            extra={"metric": "stime_decomposition",
+                   "trials_per_sec": round(D * CHUNKS / dt, 3)},
+        )
 
 
 if __name__ == "__main__":
