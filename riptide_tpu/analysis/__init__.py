@@ -1,8 +1,11 @@
 """
 riplint: the shared static-analysis framework.
 
-A single AST walk over the package feeds eight analyzers, each owning
-one stable rule id (asserted by tests/test_riplint.py):
+A single AST walk over the package feeds the per-module analyzers, and
+one shared :class:`~riptide_tpu.analysis.core.ProjectContext` (a
+name-resolved whole-program call graph: imports, self-attribute types,
+thread targets) feeds the interprocedural ones. Each analyzer owns one
+stable rule id (asserted by tests/test_riplint.py):
 
 ========  ==========================  =====================================
 RIP001    host-sync                   no host synchronisation (`.item()`,
@@ -32,6 +35,20 @@ RIP008    obs-discipline              span() only as a context manager,
                                       Pallas kernel closures, and every
                                       RIPTIDE_TRACE_*/RIPTIDE_PROM_* flag
                                       registered in envflags.py
+RIP009    lock-order                  whole-program lock-acquisition-
+                                      order cycles (held-lock sets
+                                      propagated through the call graph)
+                                      and lock-free writes to attributes
+                                      guarded elsewhere
+RIP010    record-schema               journal/ledger/incident record keys
+                                      and kinds a reader consumes are
+                                      emitted by a writer; decomposition-
+                                      merged rows don't shadow
+                                      DECOMPOSITION_KEYS
+RIP011    interp-host-sync            RIP001 lifted to call-graph
+                                      reachability: sync pulls hidden in
+                                      helpers called from jit bodies or
+                                      Pallas kernel closures
 ========  ==========================  =====================================
 
 Run via ``tools/riplint.py`` (GitHub-annotation output, checked-in
@@ -41,8 +58,8 @@ the runner loads it standalone by file path so ``make check`` needs no
 backend.
 """
 from .core import (  # noqa: F401
-    Analyzer, Baseline, Finding, ModuleContext, collect_contexts,
-    run_analyzers,
+    Analyzer, Baseline, Finding, FunctionInfo, ModuleContext,
+    ProjectContext, collect_contexts, run_analyzers,
 )
 from .host_sync import HostSyncAnalyzer
 from .dtype_discipline import DtypeDisciplineAnalyzer
@@ -52,6 +69,9 @@ from .pallas_layout import PallasLayoutAnalyzer
 from .finite_guards import FiniteGuardAnalyzer
 from .liveness_guards import LivenessGuardAnalyzer
 from .obs_discipline import ObsDisciplineAnalyzer
+from .lock_order import LockOrderAnalyzer
+from .record_schema import RecordSchemaAnalyzer
+from .interp_host_sync import InterpHostSyncAnalyzer
 
 ALL_ANALYZERS = (
     HostSyncAnalyzer,
@@ -62,9 +82,13 @@ ALL_ANALYZERS = (
     FiniteGuardAnalyzer,
     LivenessGuardAnalyzer,
     ObsDisciplineAnalyzer,
+    LockOrderAnalyzer,
+    RecordSchemaAnalyzer,
+    InterpHostSyncAnalyzer,
 )
 
 __all__ = [
-    "ALL_ANALYZERS", "Analyzer", "Baseline", "Finding", "ModuleContext",
-    "collect_contexts", "run_analyzers",
+    "ALL_ANALYZERS", "Analyzer", "Baseline", "Finding", "FunctionInfo",
+    "ModuleContext", "ProjectContext", "collect_contexts",
+    "run_analyzers",
 ] + [a.__name__ for a in ALL_ANALYZERS]

@@ -1,0 +1,113 @@
+"""
+RIP011 — interprocedural host-sync: RIP001 lifted to call-graph
+reachability.
+
+RIP001 scans the *bodies* of jit-decorated functions and an explicit
+hot-path list; a ``.item()`` moved one helper call deep passes it
+clean while still forcing the same device round trip at trace time.
+This analyzer walks the :class:`~riptide_tpu.analysis.core.
+ProjectContext` call graph from every traced root —
+
+* jit-decorated functions (``@jax.jit`` / ``partial(jax.jit, ...)`` /
+  ``cached_jit``, the RIP001 detector), and
+* Pallas kernel closures (the functions handed to ``pallas_call``,
+  via RIP005's per-module root extraction);
+
+— and flags the unambiguous sync pulls (``.item()`` / ``.tolist()`` /
+``.block_until_ready()`` / ``jax.device_get`` / ``np.asarray``-family)
+in every *reachable* helper, naming the root and the call chain so the
+finding is actionable from the message alone. Roots themselves are
+skipped (RIP001 already owns them — one defect, one rule), as are
+``"thread"``-kind edges (a spawned thread is a new host context, not
+traced code).
+
+``float()``/``int()`` on non-literals is deliberately NOT lifted:
+helpers shared between traced and host paths do legitimate host
+arithmetic, and the cast check's precision comes from knowing it runs
+at trace time — which only holds in the root's own body.
+"""
+import ast
+
+from .core import Analyzer, Finding, dotted, walk_functions, walk_own
+from .host_sync import _SYNC_ATTRS, _is_jit_decorated, _np_pull
+from .pallas_layout import PallasLayoutAnalyzer
+
+__all__ = ["InterpHostSyncAnalyzer"]
+
+
+class InterpHostSyncAnalyzer(Analyzer):
+    rule = "RIP011"
+    name = "interp-host-sync"
+    description = ("no host synchronisation anywhere reachable from a "
+                   "jit body or Pallas kernel closure through the "
+                   "project call graph")
+    needs_project = True
+
+    def run_project(self, project):
+        roots = {}
+        pallas = PallasLayoutAnalyzer()
+        for ctx in project.contexts:
+            kernel_roots = pallas._kernel_roots(ctx)
+            for qual, fn in walk_functions(ctx.tree):
+                fqn = f"{ctx.relpath}::{qual}"
+                if _is_jit_decorated(fn):
+                    roots[fqn] = "jit body"
+                elif qual.split(".")[-1] in kernel_roots \
+                        and ("." not in qual
+                             or (ctx.relpath, qual.split(".")[0])
+                             not in project.classes):
+                    # Kernel roots are module-level (or builder-nested)
+                    # functions; a class METHOD sharing the leaf name
+                    # is host code, neither a root nor exempt.
+                    roots[fqn] = "Pallas kernel closure"
+
+        parents = project.reachable(roots, kinds=("call",))
+        findings = []
+        for fqn in sorted(parents):
+            if fqn in roots:
+                continue  # RIP001/RIP005 own the root bodies
+            info = project.functions[fqn]
+            ctx = project.context_of(fqn)
+            chain = project.witness_path(parents, fqn)
+            root_fqn = fqn
+            while parents.get(root_fqn) is not None:
+                root_fqn = parents[root_fqn]
+            where = (f"`{info.qual}`, reachable from "
+                     f"{roots[root_fqn]} `"
+                     f"{project.functions[root_fqn].qual}` via "
+                     + " -> ".join(chain))
+            findings.extend(self._scan(ctx, info.node, where))
+        findings.sort(key=lambda f: (f.path, f.line, f.col))
+        return findings
+
+    def _scan(self, ctx, fn, where):
+        out = []
+        # walk_own: a nested def inside a reachable helper is its own
+        # FunctionInfo, flagged only if itself reachable.
+        for node in walk_own(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _SYNC_ATTRS \
+                    and not node.args:
+                out.append(Finding.at(
+                    ctx, node, self.rule,
+                    f"`.{f.attr}()` forces a device sync inside {where} "
+                    "— the pull is invisible to RIP001's body scan but "
+                    "still runs at trace time; hoist it to the collect "
+                    "side or take the value as a static argument",
+                ))
+            elif (dotted(f) or "").endswith("device_get"):
+                out.append(Finding.at(
+                    ctx, node, self.rule,
+                    f"`jax.device_get` inside {where} — a device->host "
+                    "pull on a traced path",
+                ))
+            elif _np_pull(node):
+                out.append(Finding.at(
+                    ctx, node, self.rule,
+                    f"`{dotted(f)}` inside {where} materialises its "
+                    "argument on the host (a silent device sync when "
+                    "fed a traced array)",
+                ))
+        return out

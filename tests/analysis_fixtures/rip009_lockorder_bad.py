@@ -1,0 +1,33 @@
+"""RIP009 bad fixture: a cross-function lock-order cycle (each lock
+acquired while the other is held, one of them through a helper call)
+plus a lock-free write to an attribute guarded elsewhere."""
+import threading
+
+_b_lock = threading.Lock()
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def add(self):
+        with self._lock:
+            self.count = self.count + 1
+            _grab_b()  # Store._lock -> _b_lock, one call deep
+
+    def reset_unlocked(self):
+        self.count = 0  # guarded in add(), lock-free here
+
+
+_store = Store()
+
+
+def _grab_b():
+    with _b_lock:
+        pass
+
+
+def flush():
+    with _b_lock:
+        _store.add()  # _b_lock -> Store._lock: the inversion

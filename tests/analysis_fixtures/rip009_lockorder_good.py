@@ -1,0 +1,33 @@
+"""RIP009 good fixture: same shapes as the bad twin, but one global
+acquisition order (never nested the other way) and every non-__init__
+write to the guarded attribute holds the lock."""
+import threading
+
+_b_lock = threading.Lock()
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def add(self):
+        with self._lock:
+            self.count = self.count + 1
+        _grab_b()  # outside the critical section: no ordering edge
+
+    def reset(self):
+        with self._lock:
+            self.count = 0
+
+
+_store = Store()
+
+
+def _grab_b():
+    with _b_lock:
+        pass
+
+
+def flush():
+    _store.add()

@@ -1,0 +1,27 @@
+"""RIP010 bad fixture: one module holding a writer half and a reader
+half that have drifted — the reader consumes a key the writer renamed
+away and filters on a kind nothing emits, and the ledger-style row
+literally names a decomposition key it later merges over itself."""
+
+
+def _append_line(path, obj):
+    del path, obj
+
+
+def write_chunk(path, cid):
+    rec = {"kind": "chunk", "chunk_id": cid, "peak_off": 0}
+    _append_line(path, rec)
+
+
+def write_row(path, decomposition):
+    row = {"kind": "ledger", "chunk_s": 0.0}
+    row.update(decomposition or {})
+    _append_line(path, row)
+
+
+def read_chunks(records):
+    out = []
+    for rec in records:
+        if rec.get("kind") == "chunkz":
+            out.append(rec["peaks_offset"])
+    return out

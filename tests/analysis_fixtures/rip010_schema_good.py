@@ -1,0 +1,26 @@
+"""RIP010 good fixture: the bad twin with both halves agreeing —
+every consumed key and kind is emitted, and the decomposition-merged
+row names no decomposition key of its own."""
+
+
+def _append_line(path, obj):
+    del path, obj
+
+
+def write_chunk(path, cid):
+    rec = {"kind": "chunk", "chunk_id": cid, "peaks_offset": 0}
+    _append_line(path, rec)
+
+
+def write_row(path, decomposition):
+    row = {"kind": "ledger", "nrows": 1}
+    row.update(decomposition or {})
+    _append_line(path, row)
+
+
+def read_chunks(records):
+    out = []
+    for rec in records:
+        if rec.get("kind") == "chunk":
+            out.append(rec["peaks_offset"])
+    return out

@@ -1,0 +1,19 @@
+"""RIP011 bad fixture: host sync pulls hidden one and two calls deep
+below a jit body — invisible to RIP001's body scan, reachable through
+the project call graph."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _deep(x):
+    return np.asarray(x).sum()
+
+
+def _peak_value(x):
+    return x.max().item() + _deep(x)
+
+
+@jax.jit
+def search(x):
+    return jnp.float32(_peak_value(x))

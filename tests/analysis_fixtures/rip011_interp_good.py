@@ -1,0 +1,17 @@
+"""RIP011 good fixture: the helper chain below the jit body stays on
+device end to end."""
+import jax
+import jax.numpy as jnp
+
+
+def _deep(x):
+    return jnp.sum(x)
+
+
+def _peak_value(x):
+    return jnp.max(x) + _deep(x)
+
+
+@jax.jit
+def search(x):
+    return jnp.float32(_peak_value(x))

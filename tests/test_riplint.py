@@ -3,16 +3,23 @@ Tier-1 enforcement of the riplint static-analysis framework
 (tools/riplint.py + riptide_tpu/analysis/):
 
 * the repo itself is clean against the checked-in baseline (this is
-  the tier-1 wiring of every analyzer, including the ported finite- and
-  liveness-guard rules);
-* each of the 8 analyzers fails on its bad fixture and passes on its
+  the tier-1 wiring of every analyzer, including the whole-program
+  RIP009/RIP010/RIP011 rules — each also wired individually below);
+* each of the 11 analyzers fails on its bad fixture and passes on its
   good fixture (tests/analysis_fixtures/ — guard against vacuous
   lints);
 * the runner's exit codes, baseline absorption, stale-entry detection
-  and inline-pragma suppression behave as documented;
+  (including the nearby-lines reflow fuzz), inline-pragma suppression,
+  result cache and SARIF output behave as documented;
+* the ProjectContext call graph resolves the bindings the
+  interprocedural rules depend on (thread targets, self-methods,
+  self-attribute and return types);
+* a deliberately introduced lock-order inversion, journal-key rename
+  and one-call-deep `.item()` below a jit body are each caught by
+  their rule — on real package modules, not just fixtures;
 * the analyzer set and rule ids are stable (a rename or renumber is an
   API break for baselines and pragmas — this must be a deliberate,
-  test-acknowledged change);
+  test-acknowledged change), and `--list-rules` enumerates them;
 * docs/env_flags.md matches the envflags registry and every RIPTIDE_*
   token in package sources is a registered flag.
 """
@@ -112,6 +119,454 @@ def test_analyzer_fails_bad_and_passes_good(tmp_path, factory, dest, bad,
     inst2 = factory()
     findings = _run_one(repo_good, inst2, dest)
     assert findings == [], "\n".join(f.gh() for f in findings)
+
+
+# -- whole-program analyzer fixture pairs (run through run_analyzers so
+# the ProjectContext is built) ----------------------------------------------
+
+RECMOD = "riptide_tpu/survey/recmod.py"
+
+PROJECT_CASES = [
+    (analysis.LockOrderAnalyzer, "riptide_tpu/survey/pairmod.py",
+     "rip009_lockorder_bad.py", "rip009_lockorder_good.py", 3),
+    (lambda: analysis.RecordSchemaAnalyzer(
+        writers=[(RECMOD, "write_chunk", None),
+                 (RECMOD, "write_row", "ledger")],
+        readers=[(RECMOD, "read_chunks")]),
+     RECMOD, "rip010_schema_bad.py", "rip010_schema_good.py", 3),
+    (analysis.InterpHostSyncAnalyzer, "riptide_tpu/ops/helpers.py",
+     "rip011_interp_bad.py", "rip011_interp_good.py", 2),
+]
+
+
+def _project_mini_repo(tmp_path, mapping):
+    """A _mini_repo that also carries the real obs/schema.py (the
+    RIP010 DECOMPOSITION_KEYS source)."""
+    repo = _mini_repo(tmp_path, mapping)
+    dest = tmp_path / "riptide_tpu" / "obs" / "schema.py"
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy(os.path.join(REPO, "riptide_tpu", "obs", "schema.py"),
+                dest)
+    return repo
+
+
+@pytest.mark.parametrize(
+    "factory,dest,bad,good,min_bad", PROJECT_CASES,
+    ids=[c[2].rsplit("_", 1)[0] for c in PROJECT_CASES],
+)
+def test_project_analyzer_fails_bad_and_passes_good(tmp_path, factory,
+                                                    dest, bad, good,
+                                                    min_bad):
+    repo_bad = _project_mini_repo(tmp_path / "bad", {dest: bad})
+    inst = factory()
+    findings, _, _ = analysis.run_analyzers(repo_bad, [inst],
+                                            baseline=analysis.Baseline())
+    assert len(findings) >= min_bad, \
+        f"expected >= {min_bad} findings on {bad}, got " \
+        f"{[f.gh() for f in findings]}"
+    assert all(f.rule == inst.rule for f in findings)
+    assert all(f.path == dest and f.line >= 1 for f in findings)
+
+    repo_good = _project_mini_repo(tmp_path / "good", {dest: good})
+    inst2 = factory()
+    findings, _, _ = analysis.run_analyzers(repo_good, [inst2],
+                                            baseline=analysis.Baseline())
+    assert findings == [], "\n".join(f.gh() for f in findings)
+
+
+@pytest.mark.parametrize("cls", ["LockOrderAnalyzer",
+                                 "RecordSchemaAnalyzer",
+                                 "InterpHostSyncAnalyzer"])
+def test_new_rule_clean_on_repo_against_baseline(cls):
+    """Tier-1 wiring of each whole-program rule individually: the real
+    repo is clean (any sanctioned site is a justified baseline entry,
+    and stale entries of OTHER rules are expected when running one
+    analyzer alone)."""
+    baseline = analysis.Baseline.load(
+        os.path.join(REPO, "tools", "riplint_baseline.json"))
+    new, _, _ = analysis.run_analyzers(REPO, [getattr(analysis, cls)],
+                                       baseline=baseline)
+    assert new == [], "\n".join(f.gh() for f in new)
+
+
+# -- ProjectContext call graph ----------------------------------------------
+
+def test_call_graph_thread_target_and_self_resolution(tmp_path):
+    """The bindings the interprocedural rules stand on: Thread(target=
+    self._meth) edges, self.attr typing through __init__ assignment,
+    and constructor/return-type resolution."""
+    repo = str(tmp_path)
+    mod = tmp_path / "riptide_tpu" / "workmod.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "import threading\n"
+        "\n"
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self.helper = Helper()\n"
+        "\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop, daemon=True)"
+        ".start()\n"
+        "\n"
+        "    def _loop(self):\n"
+        "        self.helper.tick()\n"
+        "\n"
+        "class Helper:\n"
+        "    def tick(self):\n"
+        "        pass\n"
+        "\n"
+        "def make():\n"
+        "    return Worker()\n"
+        "\n"
+        "def spin():\n"
+        "    make().start()\n"
+    )
+    project = analysis.ProjectContext(
+        repo, analysis.collect_contexts(repo))
+    rel = "riptide_tpu/workmod.py"
+
+    def edges(qual, kind):
+        info = project.functions[f"{rel}::{qual}"]
+        return {c for _, c, k in info.calls if k == kind}
+
+    assert f"{rel}::Worker._loop" in edges("Worker.start", "thread")
+    assert f"{rel}::Helper.tick" in edges("Worker._loop", "call")
+    # Return-type inference: make() -> Worker, so make().start()
+    # resolves.
+    assert f"{rel}::Worker.start" in edges("spin", "call")
+    # Reachability crosses thread edges only when asked to.
+    roots = [f"{rel}::spin"]
+    assert f"{rel}::Worker._loop" not in project.reachable(roots)
+    assert f"{rel}::Worker._loop" in project.reachable(
+        roots, kinds=("call", "thread"))
+
+
+def test_explicit_acquire_inversion_is_caught(tmp_path):
+    """A manual `A.acquire() ... try/finally: A.release()` region
+    holds A for the statements between, so an inversion written in
+    that style must produce the same RIP009 cycle as the `with` form
+    (review regression)."""
+    repo = str(tmp_path)
+    mod = tmp_path / "riptide_tpu" / "manlock.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "import threading\n"
+        "_a = threading.Lock()\n"
+        "_b = threading.Lock()\n"
+        "def one():\n"
+        "    _a.acquire()\n"
+        "    try:\n"
+        "        with _b:\n"
+        "            pass\n"
+        "    finally:\n"
+        "        _a.release()\n"
+        "def two():\n"
+        "    with _b:\n"
+        "        with _a:\n"
+        "            pass\n"
+    )
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.LockOrderAnalyzer], baseline=analysis.Baseline())
+    msgs = [f.gh() for f in new]
+    assert any("lock-order inversion" in m for m in msgs), msgs
+
+
+def test_balanced_try_finally_acquire_does_not_phantom_hold(tmp_path):
+    """A self-contained `try: A.acquire() ... finally: A.release()`
+    nets to nothing: the statements AFTER it run lock-free, so a
+    later `with _b:` must not create an A->B edge (effects are applied
+    in source order, not AST-walk order — review regression)."""
+    repo = str(tmp_path)
+    mod = tmp_path / "riptide_tpu" / "balanced.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "import threading\n"
+        "_a = threading.Lock()\n"
+        "_b = threading.Lock()\n"
+        "def one():\n"
+        "    try:\n"
+        "        _a.acquire()\n"
+        "    finally:\n"
+        "        _a.release()\n"
+        "    with _b:\n"
+        "        pass\n"
+        "def two():\n"
+        "    with _b:\n"
+        "        with _a:\n"
+        "            pass\n"
+    )
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.LockOrderAnalyzer], baseline=analysis.Baseline())
+    assert new == [], "\n".join(f.gh() for f in new)
+
+
+def test_rlock_reentrant_acquisition_not_flagged(tmp_path):
+    """Re-acquiring a module-level RLock beneath itself is the whole
+    point of RLock and must not be reported as a self-deadlock; the
+    same shape with a plain Lock must be (review regression)."""
+    repo = str(tmp_path)
+    mod = tmp_path / "riptide_tpu" / "remod.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "import threading\n"
+        "_r = threading.RLock()\n"
+        "def outer():\n"
+        "    with _r:\n"
+        "        inner()\n"
+        "def inner():\n"
+        "    with _r:\n"
+        "        pass\n"
+    )
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.LockOrderAnalyzer], baseline=analysis.Baseline())
+    assert new == [], "\n".join(f.gh() for f in new)
+
+    mod.write_text(mod.read_text().replace("RLock", "Lock"))
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.LockOrderAnalyzer], baseline=analysis.Baseline())
+    assert len(new) == 1 and "self-deadlock" in new[0].message, \
+        [f.gh() for f in new]
+
+
+def test_call_graph_relative_imports_in_package_init(tmp_path):
+    """`from .impl import helper` inside an __init__.py resolves
+    against the package ITSELF (its dotted name already names the
+    package — one fewer component to strip; review regression)."""
+    repo = str(tmp_path)
+    pkg = tmp_path / "riptide_tpu" / "pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text(
+        "from .impl import helper\n"
+        "def run():\n"
+        "    return helper()\n"
+    )
+    (pkg / "impl.py").write_text("def helper():\n    return 1\n")
+    project = analysis.ProjectContext(
+        repo, analysis.collect_contexts(repo))
+    info = project.functions["riptide_tpu/pkg/__init__.py::run"]
+    assert [(c, k) for _, c, k in info.calls] == \
+        [("riptide_tpu/pkg/impl.py::helper", "call")]
+
+
+def test_nested_def_under_lock_is_not_attributed_to_outer(tmp_path):
+    """Defining (without calling) a function under a held lock defers
+    its body: no ordering edge may flow from the definition site, so
+    the legitimate B->A order elsewhere is not a cycle (review
+    regression). Same boundary keeps an uncalled host callback defined
+    inside a jit body out of RIP011."""
+    repo = str(tmp_path)
+    mod = tmp_path / "riptide_tpu" / "defermod.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "import threading\n"
+        "import jax\n"
+        "_a = threading.Lock()\n"
+        "_b = threading.Lock()\n"
+        "def takes_b():\n"
+        "    with _b:\n"
+        "        pass\n"
+        "def outer():\n"
+        "    with _a:\n"
+        "        def deferred():\n"
+        "            takes_b()\n"
+        "        return deferred\n"
+        "def other():\n"
+        "    with _b:\n"
+        "        with _a:\n"
+        "            pass\n"
+        "def defines_acquirer():\n"
+        "    def helper():\n"
+        "        _a.acquire()\n"
+        "    with _a:\n"
+        "        return helper\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    def callback(v):\n"
+        "        return v.item()\n"
+        "    return x\n"
+    )
+    new, _, _ = analysis.run_analyzers(
+        repo,
+        [analysis.LockOrderAnalyzer, analysis.InterpHostSyncAnalyzer],
+        baseline=analysis.Baseline())
+    assert new == [], "\n".join(f.gh() for f in new)
+
+
+# -- the three acceptance demonstrations on real package modules ------------
+
+def _copy_real(tmp_path, rels):
+    for rel in rels:
+        dest = tmp_path / rel
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dest)
+    return str(tmp_path)
+
+
+def _patched(path, old, new):
+    src = path.read_text()
+    assert old in src, f"patch anchor missing from {path}: {old!r}"
+    path.write_text(src.replace(old, new))
+
+
+def test_introduced_lock_order_inversion_is_caught(tmp_path):
+    """Deliberately invert the incidents-lock / metrics-lock order on
+    the REAL modules: emit() bumps the metrics counter while holding
+    incidents._lock, and MetricsRegistry.add() reads last_incident()
+    under its own lock. RIP009 must report the cycle."""
+    rels = ["riptide_tpu/survey/incidents.py",
+            "riptide_tpu/survey/metrics.py"]
+    repo = _copy_real(tmp_path, rels)
+    # Clean copies first: no findings.
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.LockOrderAnalyzer],
+        baseline=analysis.Baseline())
+    assert new == [], "\n".join(f.gh() for f in new)
+
+    _patched(
+        tmp_path / "riptide_tpu" / "survey" / "incidents.py",
+        "    get_metrics().add(\"incidents\")\n"
+        "    with _lock:\n",
+        "    with _lock:\n"
+        "        get_metrics().add(\"incidents\")\n",
+    )
+    _patched(
+        tmp_path / "riptide_tpu" / "survey" / "metrics.py",
+        "    def add(self, name, value=1):\n"
+        "        \"\"\"Increment counter ``name`` by ``value``.\"\"\"\n"
+        "        with self._lock:\n",
+        "    def add(self, name, value=1):\n"
+        "        \"\"\"Increment counter ``name`` by ``value``.\"\"\"\n"
+        "        from .incidents import last_incident\n"
+        "        with self._lock:\n"
+        "            last_incident()\n",
+    )
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.LockOrderAnalyzer],
+        baseline=analysis.Baseline())
+    msgs = [f.gh() for f in new]
+    assert any("lock-order inversion" in m and "RIP009" in m
+               for m in msgs), msgs
+
+
+def test_renamed_journal_key_is_caught(tmp_path):
+    """Rename a chunk-record writer key on the REAL journal module: the
+    resume loader still reads the old name, and RIP010 must flag the
+    read as consuming a key no writer emits."""
+    rels = ["riptide_tpu/survey/journal.py"]
+    repo = _copy_real(tmp_path, rels)
+    writers = [("riptide_tpu/survey/journal.py", q, f) for q, f in [
+        ("SurveyJournal.write_header", None),
+        ("SurveyJournal.record_chunk", None),
+        ("SurveyJournal.record_parked", None),
+        ("SurveyJournal.record_metrics", None),
+        ("SurveyJournal.record_incident", "incident"),
+        ("SurveyJournal.heartbeat", "heartbeat"),
+    ]]
+    readers = [("riptide_tpu/survey/journal.py", None)]
+
+    def run_schema():
+        inst = analysis.RecordSchemaAnalyzer(writers=writers,
+                                             readers=readers)
+        new, _, _ = analysis.run_analyzers(repo, [inst],
+                                           baseline=analysis.Baseline())
+        return new
+
+    assert run_schema() == [], \
+        "\n".join(f.gh() for f in run_schema())
+    _patched(tmp_path / "riptide_tpu" / "survey" / "journal.py",
+             '"peaks_offset": offset,', '"peak_off": offset,')
+    new = run_schema()
+    assert any("'peaks_offset'" in f.message and f.rule == "RIP010"
+               for f in new), [f.gh() for f in new]
+
+
+def test_kernel_root_leaf_name_does_not_capture_methods(tmp_path):
+    """A class method sharing a Pallas kernel root's leaf name is host
+    code: it must be neither treated as a traced root (false RIP011
+    findings in its callees) nor exempted from scanning (review
+    regression)."""
+    repo = str(tmp_path)
+    mod = tmp_path / "riptide_tpu" / "kmod.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "from jax.experimental import pallas as pl\n"
+        "def _body(ref):\n"
+        "    pass\n"
+        "def launch(x, shp):\n"
+        "    return pl.pallas_call(_body, out_shape=shp, grid=(1,))(x)\n"
+        "def _host_helper(v):\n"
+        "    return v.item()\n"
+        "class Stats:\n"
+        "    def _body(self, v):\n"
+        "        return _host_helper(v)\n"
+    )
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.InterpHostSyncAnalyzer],
+        baseline=analysis.Baseline())
+    assert new == [], "\n".join(f.gh() for f in new)
+
+
+def test_local_constructor_binding_is_flow_sensitive(tmp_path):
+    """A rebound local must not type earlier uses: `x = maker();
+    x.close(); x = Helper()` may not produce an edge to Helper.close,
+    while a straight bind-then-use still resolves (review
+    regression)."""
+    repo = str(tmp_path)
+    mod = tmp_path / "riptide_tpu" / "flowmod.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "class Helper:\n"
+        "    def close(self):\n"
+        "        pass\n"
+        "def f(maker):\n"
+        "    x = maker()\n"
+        "    x.close()\n"
+        "    x = Helper()\n"
+        "    return x\n"
+        "def g():\n"
+        "    h = Helper()\n"
+        "    h.close()\n"
+    )
+    project = analysis.ProjectContext(
+        repo, analysis.collect_contexts(repo))
+    rel = "riptide_tpu/flowmod.py"
+    f_edges = {c for _, c, _ in project.functions[f"{rel}::f"].calls}
+    g_edges = {c for _, c, _ in project.functions[f"{rel}::g"].calls}
+    assert f"{rel}::Helper.close" not in f_edges
+    assert f"{rel}::Helper.close" in g_edges
+
+
+def test_one_call_deep_item_in_jit_helper_is_caught(tmp_path):
+    """A `.item()` moved one helper call below a jit body passes
+    RIP001's body scan and must be caught by RIP011 instead, with the
+    root and call chain named in the message."""
+    repo = str(tmp_path)
+    mod = tmp_path / "riptide_tpu" / "jithelp.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "def _threshold(x):\n"
+        "    return x.min().item()\n"
+        "\n"
+        "@jax.jit\n"
+        "def scan(x):\n"
+        "    return jnp.clip(x, _threshold(x), None)\n"
+    )
+    # RIP001 (body-only) misses it...
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.HostSyncAnalyzer(hot_functions={})],
+        baseline=analysis.Baseline())
+    assert new == [], "\n".join(f.gh() for f in new)
+    # ... RIP011 catches it and names the chain.
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.InterpHostSyncAnalyzer],
+        baseline=analysis.Baseline())
+    assert len(new) == 1 and new[0].rule == "RIP011", \
+        [f.gh() for f in new]
+    assert "scan" in new[0].message and "_threshold" in new[0].message
 
 
 def test_liveness_good_fixture_not_vacuous(tmp_path):
@@ -319,9 +774,25 @@ def test_analyzer_set_and_rule_ids_are_stable():
         ("RIP006", "finite-guards"),
         ("RIP007", "liveness-guards"),
         ("RIP008", "obs-discipline"),
+        ("RIP009", "lock-order"),
+        ("RIP010", "record-schema"),
+        ("RIP011", "interp-host-sync"),
     }
     rules = [a.rule for a in analysis.ALL_ANALYZERS]
-    assert len(rules) == len(set(rules)) == 8
+    assert len(rules) == len(set(rules)) == 11
+
+
+def test_list_rules_enumerates_the_set():
+    proc = subprocess.run([sys.executable, RIPLINT, "--list-rules"],
+                          capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 11
+    ids = [l.split()[0] for l in lines]
+    assert ids == [f"RIP{n:03d}" for n in range(1, 12)]
+    assert any("lock-order" in l for l in lines)
+    assert any("record-schema" in l for l in lines)
+    assert any("interp-host-sync" in l for l in lines)
 
 
 def test_env_docs_in_sync_with_registry():
@@ -351,3 +822,177 @@ def test_baseline_entries_are_justified():
     for e in entries:
         assert e["why"] and "TODO" not in e["why"], \
             f"unjustified baseline entry: {e}"
+
+
+# -- baseline nearby-lines staleness fuzz -----------------------------------
+
+def test_baseline_entry_survives_nearby_line_reflow(tmp_path):
+    """An entry whose text survives within +-3 lines of a finding
+    whose own text is a fragment of it (the flagged line of a
+    reworked statement moved under an unrelated edit) must still
+    absorb the finding and must NOT read as stale; an entry matching
+    nothing anywhere near stays stale."""
+    dest = "riptide_tpu/survey/liveness.py"
+    repo = str(tmp_path)
+    mod = tmp_path / dest
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "def drain(worker):\n"
+        "    worker.join()  # riplint: disable=RIP004\n"
+        "    worker.join()\n"
+    )
+    analyzers = [analysis.LockDisciplineAnalyzer(modules={dest})]
+    nearby = [{"rule": "RIP004", "path": dest,
+               "line_text": "worker.join()  # riplint: disable=RIP004",
+               "why": "reflow fuzz"}]
+    new, baselined, stale = analysis.run_analyzers(
+        repo, analyzers, baseline=analysis.Baseline(nearby))
+    assert new == [] and len(baselined) == 1 and stale == []
+
+    far = [{"rule": "RIP004", "path": dest,
+            "line_text": "nowhere_near_anything()", "why": "stale"}]
+    _, _, stale2 = analysis.run_analyzers(
+        repo, analyzers, baseline=analysis.Baseline(nearby + far))
+    assert stale2 == far
+
+
+def test_nearby_fuzz_requires_related_text(tmp_path):
+    """An unused entry must not absorb an UNRELATED new violation that
+    merely lands within +-3 lines of its text: the finding's own line
+    text must be a fragment of the entry's (or vice versa), and a
+    redundant entry is reported stale rather than silently consumed
+    (review regression)."""
+    dest = "riptide_tpu/survey/liveness.py"
+    repo = str(tmp_path)
+    mod = tmp_path / dest
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "def drain(worker, other):\n"
+        "    worker.join()  # riplint: disable=RIP004\n"
+        "    other.join()\n"
+    )
+    entries = [{"rule": "RIP004", "path": dest,
+                "line_text": "worker.join()  # riplint: disable=RIP004",
+                "why": "redundant"}]
+    new, baselined, stale = analysis.run_analyzers(
+        repo, [analysis.LockDisciplineAnalyzer(modules={dest})],
+        baseline=analysis.Baseline(entries))
+    assert len(new) == 1 and new[0].line == 3, [f.gh() for f in new]
+    assert baselined == [] and stale == entries
+
+
+def test_nearby_fuzz_does_not_absorb_new_neighbour_violation(tmp_path):
+    """A brand-new violation a couple of lines from a baselined one
+    must still surface: the entry exact-matches its own finding (and
+    is thereby used), so the fuzz may not also swallow the neighbour
+    (review regression)."""
+    dest = "riptide_tpu/survey/liveness.py"
+    repo = str(tmp_path)
+    mod = tmp_path / dest
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "def drain(worker):\n"
+        "    worker.join()\n"
+        "\n"
+        "def drain_two(other):\n"
+        "    other.join()\n"
+    )
+    entries = [{"rule": "RIP004", "path": dest,
+                "line_text": "worker.join()", "why": "documented"}]
+    new, baselined, stale = analysis.run_analyzers(
+        repo, [analysis.LockDisciplineAnalyzer(modules={dest})],
+        baseline=analysis.Baseline(entries))
+    assert len(baselined) == 1 and stale == []
+    assert len(new) == 1, [f.gh() for f in new]
+    assert new[0].line == 5 and new[0].rule == "RIP004"
+
+
+# -- result cache + SARIF output --------------------------------------------
+
+def test_cache_replays_unchanged_tree_and_invalidates_on_touch():
+    out1, err1 = io.StringIO(), io.StringIO()
+    code1 = riplint.run(out=out1, err=err1)  # populates the cache
+    out2, err2 = io.StringIO(), io.StringIO()
+    code2 = riplint.run(out=out2, err=err2)
+    assert code1 == code2 == 0
+    assert "[cached]" in err2.getvalue()
+    assert out1.getvalue() == out2.getvalue()
+
+    # --no-cache (use_cache=False) always runs fresh.
+    out3, err3 = io.StringIO(), io.StringIO()
+    assert riplint.run(out=out3, err=err3, use_cache=False) == 0
+    assert "[cached]" not in err3.getvalue()
+
+    # Any tracked file's mtime change invalidates the replay.
+    bench = os.path.join(REPO, "bench.py")
+    os.utime(bench)
+    out4, err4 = io.StringIO(), io.StringIO()
+    assert riplint.run(out=out4, err=err4) == 0
+    assert "[cached]" not in err4.getvalue()
+    # ... and the fresh run re-primes it.
+    out5, err5 = io.StringIO(), io.StringIO()
+    assert riplint.run(out=out5, err=err5) == 0
+    assert "[cached]" in err5.getvalue()
+
+
+def test_cache_invalidates_on_out_of_tree_baseline_edit(tmp_path):
+    """A custom --baseline outside the tracked roots is stat'd
+    explicitly: editing it must invalidate the replay (review
+    regression)."""
+    custom = tmp_path / "team_baseline.json"
+    shutil.copy(os.path.join(REPO, "tools", "riplint_baseline.json"),
+                custom)
+    riplint.run(baseline_path=str(custom), out=io.StringIO(),
+                err=io.StringIO())
+    err2 = io.StringIO()
+    riplint.run(baseline_path=str(custom), out=io.StringIO(), err=err2)
+    assert "[cached]" in err2.getvalue()
+    custom.write_text(custom.read_text().replace("}\n", "} \n", 1))
+    err3 = io.StringIO()
+    riplint.run(baseline_path=str(custom), out=io.StringIO(), err=err3)
+    assert "[cached]" not in err3.getvalue()
+
+
+def test_cache_not_used_for_custom_analyzer_sets():
+    """A caller-injected analyzer subset must bypass the cache in both
+    directions (never served, never stored)."""
+    riplint.run(out=io.StringIO(), err=io.StringIO())  # prime
+    out, err = io.StringIO(), io.StringIO()
+    riplint.run(analyzers=[analysis.HostSyncAnalyzer],
+                out=out, err=err)
+    assert "[cached]" not in err.getvalue()
+    assert "1 analyzers" in err.getvalue()
+
+
+def test_sarif_output_schema():
+    out, err = io.StringIO(), io.StringIO()
+    code = riplint.run(out=out, err=err, fmt="sarif", use_cache=False)
+    assert code == 0
+    doc = json.loads(out.getvalue())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "riplint"
+    rules = run["tool"]["driver"]["rules"]
+    assert [r["id"] for r in rules] == \
+        [f"RIP{n:03d}" for n in range(1, 12)]
+    assert all(r["shortDescription"]["text"] for r in rules)
+    assert run["results"] == []  # clean repo
+
+
+def test_sarif_findings_and_stale_entries_become_results():
+    instances = [a() for a in analysis.ALL_ANALYZERS]
+    result = {
+        "new": [{"path": "riptide_tpu/x.py", "line": 12, "col": 4,
+                 "rule": "RIP009", "message": "lock-order inversion"}],
+        "stale": [{"rule": "RIP004", "path": "riptide_tpu/y.py",
+                   "line_text": "gone()", "why": "old"}],
+        "baselined": 0, "n_rules": 11, "n_modules": 1,
+    }
+    doc = riplint._sarif_doc(result, instances)
+    results = doc["runs"][0]["results"]
+    assert len(results) == 2
+    assert results[0]["ruleId"] == "RIP009"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "riptide_tpu/x.py"
+    assert loc["region"] == {"startLine": 12, "startColumn": 5}
+    assert "STALE" in results[1]["message"]["text"]

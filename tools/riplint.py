@@ -26,14 +26,35 @@ Suppression, in reviewability order:
 
 ``--write-env-docs`` regenerates ``docs/env_flags.md`` from the
 ``utils/envflags.py`` registry (the RIP003 analyzer fails on drift).
+
+``--format sarif`` emits one SARIF 2.1.0 run (rule metadata included)
+instead of the GitHub one-liner format, for CI annotation uploads;
+``--format text`` stays the default and the exit-code contract is
+identical.
+
+Runs are cached: ``.riplint_cache.json`` (repo root, gitignored)
+records the (mtime, size) of every file the analyzers can observe plus
+a digest of the analyzer sources themselves, and an unchanged tree
+replays the recorded result without parsing anything — ``make check``
+on a clean tree is sub-second. The whole-program analyzers make
+per-file reuse unsound (one module's edit moves another module's call
+graph), so the cache is all-or-nothing by design. ``--no-cache``
+forces a full run (CI).
 """
 import argparse
+import hashlib
 import importlib.util
+import json
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = os.path.join(REPO, "tools", "riplint_baseline.json")
+CACHE_REL = ".riplint_cache.json"
+CACHE_VERSION = 1
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def load_analysis(repo=REPO):
@@ -57,15 +78,227 @@ def load_analysis(repo=REPO):
     return mod
 
 
+# -- result cache ------------------------------------------------------------
+
+def _tracked_files(repo):
+    """Repo-relative paths of every file whose content can change an
+    analyzer's output: the package sources, the out-of-package surfaces
+    the analyzers read (tools/, tests/, bench.py, Makefile — RIP003's
+    stale-flag scan and RIP010's tools-side readers), the generated
+    env-flag docs (RIP003 drift) and the baseline itself."""
+    out = []
+    for root in ("riptide_tpu", "tools", "tests"):
+        top = os.path.join(repo, root)
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fname in sorted(filenames):
+                # .mk/Makefile included to match env_flags's stale-
+                # flag usage scan over these same directories.
+                if fname.endswith((".py", ".json", ".mk")) \
+                        or fname == "Makefile":
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fname), repo))
+    for rel in ("bench.py", "Makefile", os.path.join("docs",
+                                                     "env_flags.md")):
+        if os.path.exists(os.path.join(repo, rel)):
+            out.append(rel)
+    return [p.replace(os.sep, "/") for p in out
+            if p != CACHE_REL]
+
+
+def _file_state(repo):
+    state = {}
+    for rel in _tracked_files(repo):
+        try:
+            st = os.stat(os.path.join(repo, rel))
+        except OSError:
+            continue
+        state[rel] = [st.st_mtime_ns, st.st_size]
+    return state
+
+
+def _analyzer_digest(repo):
+    """Digest over the analyzer sources and this runner: any edit to
+    the rules invalidates every cached result."""
+    h = hashlib.sha1()
+    adir = os.path.join(repo, "riptide_tpu", "analysis")
+    for name in sorted(os.listdir(adir)):
+        if name.endswith(".py"):
+            h.update(name.encode())
+            with open(os.path.join(adir, name), "rb") as fobj:
+                h.update(fobj.read())
+    with open(os.path.abspath(__file__), "rb") as fobj:
+        h.update(fobj.read())
+    return h.hexdigest()
+
+
+def _baseline_state(baseline_path):
+    """(mtime_ns, size) of the baseline, stat'd explicitly: a custom
+    --baseline may live outside the tracked roots, and its edits must
+    invalidate the cache all the same."""
+    try:
+        st = os.stat(baseline_path)
+    except OSError:
+        return None
+    return [st.st_mtime_ns, st.st_size]
+
+
+def _cache_key(repo, baseline_path):
+    """The invalidation key, computed ONCE per run and shared by the
+    load comparison and the post-run save (recomputing after the run
+    could pair fresh mtimes with a result derived from older
+    content)."""
+    return {
+        "version": CACHE_VERSION,
+        "analyzer_digest": _analyzer_digest(repo),
+        "baseline_path": os.path.relpath(baseline_path, repo),
+        "baseline_state": _baseline_state(baseline_path),
+        "files": _file_state(repo),
+    }
+
+
+def _load_cached_result(repo, key):
+    path = os.path.join(repo, CACHE_REL)
+    try:
+        with open(path) as fobj:
+            doc = json.load(fobj)
+    except (OSError, ValueError):
+        return None
+    if any(doc.get(k) != v for k, v in key.items()):
+        return None
+    return doc.get("result")
+
+
+def _save_cached_result(repo, key, result):
+    path = os.path.join(repo, CACHE_REL)
+    doc = dict(key, result=result)
+    tmp = f"{path}.tmp"
+    try:
+        with open(tmp, "w") as fobj:
+            json.dump(doc, fobj, indent=1)
+            fobj.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        pass  # best-effort: a read-only tree just runs uncached
+
+
+# -- output formats ----------------------------------------------------------
+
+def _sarif_doc(result, analyzers):
+    """One SARIF 2.1.0 run: the analyzer set as rule metadata, each new
+    finding (and stale baseline entry) as a result."""
+    rules = [
+        {
+            "id": a.rule,
+            "name": a.name,
+            "shortDescription": {"text": a.description or a.name},
+        }
+        for a in analyzers
+    ]
+    results = []
+    for f in result["new"]:
+        results.append({
+            "ruleId": f["rule"],
+            "level": "error",
+            "message": {"text": f["message"]},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f["path"]},
+                    "region": {"startLine": max(1, f["line"]),
+                               "startColumn": f["col"] + 1},
+                },
+            }],
+        })
+    for e in result["stale"]:
+        results.append({
+            "ruleId": e["rule"],
+            "level": "error",
+            "message": {"text": (
+                f"STALE baseline entry (line_text={e['line_text']!r}) "
+                "— the code it justified is gone; delete the entry or "
+                "run --update-baseline")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": e["path"]},
+                    "region": {"startLine": 1, "startColumn": 1},
+                },
+            }],
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            # informationUri omitted: the property requires an
+            # absolute URI and this tool has no canonical public URL
+            # (docs/static_analysis.md is the in-repo reference).
+            "tool": {"driver": {
+                "name": "riplint",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+
+
+def _emit(result, analyzers, fmt, out, err, cached=False):
+    """Render one (possibly replayed) result; returns the exit code."""
+    n_new, n_stale = len(result["new"]), len(result["stale"])
+    if fmt == "sarif":
+        json.dump(_sarif_doc(result, analyzers), out, indent=2)
+        out.write("\n")
+    else:
+        for f in result["new"]:
+            print(f"{f['path']}:{f['line']}:{f['col']}: {f['rule']} "
+                  f"{f['message']}", file=out)
+        for e in result["stale"]:
+            print(
+                f"{e['path']}:1:0: {e['rule']} STALE baseline entry "
+                f"(line_text={e['line_text']!r}) — the code it justified "
+                "is gone; delete the entry or run --update-baseline",
+                file=out,
+            )
+    tag = " [cached]" if cached else ""
+    if n_new or n_stale:
+        print(
+            f"riplint: {n_new} new finding(s), {n_stale} stale "
+            f"baseline entr(y/ies) ({result['baselined']} baselined, "
+            f"{result['n_rules']} analyzers over {result['n_modules']} "
+            f"modules){tag}",
+            file=err,
+        )
+        return 1
+    print(
+        f"riplint OK: {result['n_rules']} analyzers over "
+        f"{result['n_modules']} modules, 0 new findings "
+        f"({result['baselined']} baselined){tag}", file=err,
+    )
+    return 0
+
+
 def run(repo=REPO, baseline_path=DEFAULT_BASELINE, analyzers=None,
-        update_baseline=False, out=sys.stdout, err=sys.stderr):
+        update_baseline=False, out=sys.stdout, err=sys.stderr,
+        fmt="text", use_cache=True):
     """Run the analyzers; returns the process exit code."""
     analysis = load_analysis(repo)
+    # Only runs of the full default analyzer set are cacheable — a
+    # caller-injected subset must never poison (or be served) the
+    # default result.
+    cacheable = analyzers is None and not update_baseline and use_cache
     analyzers = analyzers or analysis.ALL_ANALYZERS
+    instances = [a() if isinstance(a, type) else a for a in analyzers]
+
+    cache_key = None
+    if cacheable:
+        cache_key = _cache_key(repo, baseline_path)
+        result = _load_cached_result(repo, cache_key)
+        if result is not None:
+            return _emit(result, instances, fmt, out, err, cached=True)
+
     baseline = analysis.Baseline.load(baseline_path)
     contexts = analysis.collect_contexts(repo)
     new, baselined, stale = analysis.run_analyzers(
-        repo, analyzers, baseline=baseline, contexts=contexts
+        repo, instances, baseline=baseline, contexts=contexts
     )
 
     if update_baseline:
@@ -100,30 +333,19 @@ def run(repo=REPO, baseline_path=DEFAULT_BASELINE, analyzers=None,
         )
         return 0
 
-    for f in new:
-        print(f.gh(), file=out)
-    for e in stale:
-        print(
-            f"{e['path']}:1:0: {e['rule']} STALE baseline entry "
-            f"(line_text={e['line_text']!r}) — the code it justified is "
-            "gone; delete the entry or run --update-baseline",
-            file=out,
-        )
-    n_rules = len({a.rule for a in
-                   (x() if isinstance(x, type) else x for x in analyzers)})
-    if new or stale:
-        print(
-            f"riplint: {len(new)} new finding(s), {len(stale)} stale "
-            f"baseline entr(y/ies) ({len(baselined)} baselined, "
-            f"{n_rules} analyzers over {len(contexts)} modules)",
-            file=err,
-        )
-        return 1
-    print(
-        f"riplint OK: {n_rules} analyzers over {len(contexts)} modules, "
-        f"0 new findings ({len(baselined)} baselined)", file=err,
-    )
-    return 0
+    result = {
+        "new": [{"path": f.path, "line": f.line, "col": f.col,
+                 "rule": f.rule, "message": f.message} for f in new],
+        "stale": list(stale),
+        "baselined": len(baselined),
+        "n_rules": len({i.rule for i in instances}),
+        "n_modules": len(contexts),
+    }
+    if cacheable:
+        # --no-cache runs never write either (the documented CI
+        # contract): cacheable already folds use_cache in.
+        _save_cached_result(repo, cache_key, result)
+    return _emit(result, instances, fmt, out, err)
 
 
 def main(argv=None):
@@ -138,6 +360,13 @@ def main(argv=None):
                     help="rewrite the baseline to absorb current "
                          "findings (justifications of surviving entries "
                          "are kept; new entries get a TODO)")
+    ap.add_argument("--format", choices=("text", "sarif"),
+                    default="text", dest="fmt",
+                    help="output format: GitHub-annotation text "
+                         "(default) or one SARIF 2.1.0 run")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write .riplint_cache.json "
+                         "(CI / make check-full)")
     ap.add_argument("--write-env-docs", action="store_true",
                     help="regenerate docs/env_flags.md from the "
                          "utils/envflags.py registry and exit")
@@ -159,7 +388,8 @@ def main(argv=None):
         print(f"wrote {os.path.relpath(path, REPO)}", file=sys.stderr)
         return 0
     return run(baseline_path=args.baseline,
-               update_baseline=args.update_baseline)
+               update_baseline=args.update_baseline,
+               fmt=args.fmt, use_cache=not args.no_cache)
 
 
 if __name__ == "__main__":
