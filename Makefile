@@ -3,7 +3,7 @@
 PYTHON ?= python
 
 .PHONY: install check check-full lint native-asan sanitize tests \
-	tests-cov native bench trace-demo report-demo clean
+	tests-cov native bench trace-demo report-demo chaos clean
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -87,6 +87,16 @@ trace-demo:
 # (see docs/observability.md).
 report-demo:
 	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) tools/report_demo.py
+
+# Storage-chaos campaign: a tiny CPU survey run as subprocess legs that
+# are KILLED mid-write at journal/ledger/cache boundaries (plus
+# ENOSPC/fsync/torn-write degradations on the observability paths) and
+# resumed — every schedule must end with byte-identical peaks.csv, a
+# consistent journal, a ledger row and an incident per injected fault.
+# Runs the fixed builtin schedule set (CI-compatible time); a fuller
+# seeded sweep: tools/rchaos.py --sweep N (see docs/fault_tolerance.md).
+chaos:
+	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) tools/rchaos.py
 
 clean:
 	rm -rf riptide_tpu/native/_build build dist *.egg-info

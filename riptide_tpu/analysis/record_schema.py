@@ -92,14 +92,22 @@ READER_SPECS = (
 # statically extractable surface). Each entry carries its why; bump the
 # version whenever the set changes so the diff is a deliberate act.
 RECORD_ALLOWLIST = {
-    "version": 1,
+    "version": 2,
     "keys": {
         # Not a record key: TimeSeries.metadata field read while the
         # scheduler BUILDS the chunk record's dms list (the reader
         # scope covers _run whole for its resume reads).
         "dm": "TimeSeries.metadata field, not a journal record key",
     },
-    "kinds": {},
+    "kinds": {
+        # Ledger rows' kind is the make_row(kind=...) ARGUMENT, set at
+        # each call site ("survey"/"rseek"/"bench"/"stime") rather than
+        # a dict literal the writer extraction can see. The scheduler's
+        # full-replay resume filters ledger rows on it (PR 11: append
+        # the row a killed predecessor never managed, exactly once).
+        "survey": "ledger row kind passed dynamically via "
+                  "ledger.maybe_append('survey', ...)",
+    },
 }
 
 

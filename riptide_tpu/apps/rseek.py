@@ -134,7 +134,7 @@ def _search_with_survey_hooks(args, ts):
     record of the completed unit."""
     import os
 
-    from riptide_tpu.utils import envflags
+    from riptide_tpu.utils import envflags, fsio
     from riptide_tpu.survey import incidents
     from riptide_tpu.survey.faults import FaultPlan
     from riptide_tpu.survey.journal import SurveyJournal
@@ -152,81 +152,96 @@ def _search_with_survey_hooks(args, ts):
          ("Pmin", "Pmax", "bmin", "bmax", "smin", "wtsp",
           "rmed_width", "rmed_minpts", "clrad")},
     )
-    if journal is not None:
-        journal.write_header(sid, 1)
-        if args.resume:
-            done = journal.completed_chunks()
-            if 0 in done and done[0][0].get("files") == \
-                    [os.path.basename(args.fname)]:
-                log.info("resuming: peaks replayed from journal "
-                         f"{args.journal!r}")
-                get_metrics().add("chunks_skipped")
-                return done[0][1]
-
     faults = FaultPlan.parse(args.fault_inject
                              or envflags.get("RIPTIDE_FAULT_INJECT"))
-    # nan_inject directives corrupt the loaded samples BEFORE the
-    # data-quality scan inside ffa_search, exercising the masking path.
-    faults.nan_inject(0, ts.data)
     metrics = get_metrics()
-    retry = RetryPolicy(deadline_s=getattr(args, "deadline_s", None))
-    # Phase attribution via timer deltas: the engine records prep/wire/
-    # device seconds while the search runs; the deltas across this one
-    # work unit feed the journal's `timing` block (the same schema the
-    # survey scheduler journals per chunk).
-    prep0 = metrics.timer_total("prep_s")
-    wire0 = metrics.timer_total("wire_s")
-    dev0 = metrics.timer_total("device_s")
-    wb0 = metrics.counter("wire_bytes")
     # Journaled searches sink incidents (quarantine, OOM bisection,
-    # watchdog timeout) into the journal for the run's duration, like
-    # the survey scheduler does per survey.
+    # watchdog timeout, storage recovery) into the journal for the
+    # run's duration, like the survey scheduler does per survey — the
+    # sink is installed BEFORE write_header so the crash-recovery pass
+    # (torn-tail truncation) journals what it repaired. Storage fault
+    # directives fire through the fsio hook for the same window.
     prev_sink = None
+    prev_hook = fsio.set_storage_faults(faults.storage_op)
     if journal is not None:
         incidents.clear_last()
         prev_sink = incidents.set_sink(journal.record_incident)
-    t0 = time.perf_counter()
     try:
+        if journal is not None:
+            journal.write_header(sid, 1)
+            if args.resume:
+                done = journal.completed_chunks()
+                if 0 in done and done[0][0].get("files") == \
+                        [os.path.basename(args.fname)]:
+                    log.info("resuming: peaks replayed from journal "
+                             f"{args.journal!r}")
+                    get_metrics().add("chunks_skipped")
+                    return done[0][1]
+
+        # nan_inject directives corrupt the loaded samples BEFORE the
+        # data-quality scan inside ffa_search, exercising the masking
+        # path.
+        faults.nan_inject(0, ts.data)
+        retry = RetryPolicy(deadline_s=getattr(args, "deadline_s", None))
+        # Phase attribution via timer deltas: the engine records prep/
+        # wire/device seconds while the search runs; the deltas across
+        # this one work unit feed the journal's `timing` block (the
+        # same schema the survey scheduler journals per chunk).
+        prep0 = metrics.timer_total("prep_s")
+        wire0 = metrics.timer_total("wire_s")
+        dev0 = metrics.timer_total("device_s")
+        wb0 = metrics.counter("wire_bytes")
+        t0 = time.perf_counter()
         peaks, attempts = run_with_retry(
             lambda: _search_peaks(args, ts), 0, retry, faults, metrics,
         )
+        chunk_s = time.perf_counter() - t0
+        metrics.add("chunks_done")
+        metrics.observe("chunk_s", chunk_s)
+        if journal is not None:
+            from riptide_tpu.obs import ledger
+            from riptide_tpu.obs.report import run_decomposition_from_chunks
+            from riptide_tpu.obs.schema import chunk_timing
+
+            device_s = metrics.timer_total("device_s") - dev0
+            timing = chunk_timing(
+                chunk_s,
+                prep_s=metrics.timer_total("prep_s") - prep0,
+                wire_s=metrics.timer_total("wire_s") - wire0,
+                device_s=device_s,
+                # The blocking device wait happens inside the search
+                # call's collect; attribute it there rather than to the
+                # host remainder.
+                collect_s=device_s,
+                wire_bytes=int(metrics.counter("wire_bytes") - wb0),
+            )
+            try:
+                journal.heartbeat(0)
+            except OSError as err:
+                # Observability writes are never fatal (the survey
+                # scheduler applies the same guard per chunk).
+                log.warning("heartbeat append failed: %s", err)
+                metrics.add("obs_write_errors")
+                incidents.emit("obs_write_failed", op="heartbeat",
+                               error=str(err))
+            journal.record_chunk(
+                0, [args.fname], [float(ts.metadata["dm"] or 0.0)], peaks,
+                timings=timing, attempts=attempts,
+            )
+            journal.record_metrics(metrics.summary())
+            # One perf-ledger row per journaled search (no-op unless
+            # RIPTIDE_LEDGER is set) — same derivation as the
+            # scheduler's.
+            run_dec, nchunks, bound_counts = \
+                run_decomposition_from_chunks([timing])
+            ledger.maybe_append("rseek", run_dec, nchunks=nchunks,
+                                bound_counts=bound_counts,
+                                extra={"survey_id": sid})
+        return peaks
     finally:
+        fsio.set_storage_faults(prev_hook)
         if journal is not None:
             incidents.set_sink(prev_sink)
-    chunk_s = time.perf_counter() - t0
-    metrics.add("chunks_done")
-    metrics.observe("chunk_s", chunk_s)
-    if journal is not None:
-        from riptide_tpu.obs import ledger
-        from riptide_tpu.obs.report import run_decomposition_from_chunks
-        from riptide_tpu.obs.schema import chunk_timing
-
-        device_s = metrics.timer_total("device_s") - dev0
-        timing = chunk_timing(
-            chunk_s,
-            prep_s=metrics.timer_total("prep_s") - prep0,
-            wire_s=metrics.timer_total("wire_s") - wire0,
-            device_s=device_s,
-            # The blocking device wait happens inside the search
-            # call's collect; attribute it there rather than to the
-            # host remainder.
-            collect_s=device_s,
-            wire_bytes=int(metrics.counter("wire_bytes") - wb0),
-        )
-        journal.heartbeat(0)
-        journal.record_chunk(
-            0, [args.fname], [float(ts.metadata["dm"] or 0.0)], peaks,
-            timings=timing, attempts=attempts,
-        )
-        journal.record_metrics(metrics.summary())
-        # One perf-ledger row per journaled search (no-op unless
-        # RIPTIDE_LEDGER is set) — same derivation as the scheduler's.
-        run_dec, nchunks, bound_counts = \
-            run_decomposition_from_chunks([timing])
-        ledger.maybe_append("rseek", run_dec, nchunks=nchunks,
-                            bound_counts=bound_counts,
-                            extra={"survey_id": sid})
-    return peaks
 
 
 def run_program(args):
@@ -290,7 +305,16 @@ def run_program(args):
         else:
             trace_path = args.fname + ".trace.json"
             if tracer is not None:
-                write_chrome_trace(trace_path, tracer)
+                try:
+                    write_chrome_trace(trace_path, tracer)
+                except OSError as err:
+                    # Observability writes are never fatal: a full disk
+                    # must not eat the completed search's results.
+                    log.warning("trace write to %r failed: %s",
+                                trace_path, err)
+                    from riptide_tpu.obs.ledger import _obs_write_failed
+
+                    _obs_write_failed("trace", trace_path, err)
         log.info(f"host span trace written to {trace_path!r} "
                  "(load in Perfetto or chrome://tracing)")
     prom.maybe_write_textfile()

@@ -22,7 +22,12 @@ tracer records.
 """
 import glob
 import json
+import logging
 import os
+
+from ..utils import fsio
+
+log = logging.getLogger("riptide_tpu.obs.chrome")
 
 __all__ = ["chrome_events", "write_chrome_trace", "merge_chrome_traces",
            "export_run_trace", "rotate_trace_file"]
@@ -91,11 +96,8 @@ def write_chrome_trace(path, tracer, pid=0, process_name="riptide_tpu"):
             "dropped_events": tracer.dropped_events,
         },
     }
-    tmp = f"{path}.tmp"
-    with open(tmp, "w") as fobj:
-        json.dump(doc, fobj)
-    os.replace(tmp, path)
-    return path
+    return fsio.atomic_write_text(path, json.dumps(doc),
+                                  site="trace_export")
 
 
 def merge_chrome_traces(paths, out):
@@ -125,11 +127,8 @@ def merge_chrome_traces(paths, out):
             "wall_t0_unix_s": base,
         },
     }
-    tmp = f"{out}.tmp"
-    with open(tmp, "w") as fobj:
-        json.dump(merged, fobj)
-    os.replace(tmp, out)
-    return out
+    return fsio.atomic_write_text(out, json.dumps(merged),
+                                  site="trace_export")
 
 
 def export_run_trace(directory, process_index=0, process_count=1,
@@ -151,7 +150,13 @@ def export_run_trace(directory, process_index=0, process_count=1,
     instead of overwriting it, while same-run re-exports (e.g. the
     scheduler's end-of-search export followed by rffa's post-stage
     re-export, or per-chunk multihost lane rewrites) keep overwriting
-    in place."""
+    in place.
+
+    Export failure is NEVER fatal: a full disk or I/O error while
+    writing the trace degrades to an ``obs_write_failed`` incident plus
+    the ``obs_write_errors`` counter, and the run whose trace this is
+    completes regardless (the hard invariant of the observability
+    surface)."""
     if tracer is None:
         from .trace import get_tracer
 
@@ -166,13 +171,23 @@ def export_run_trace(directory, process_index=0, process_count=1,
         return path
 
     merged_path = os.path.join(directory, "trace.json")
-    if process_count <= 1:
-        return write_chrome_trace(target(merged_path), tracer)
-    own = os.path.join(directory,
-                       f"trace_{int(process_index):04d}.json")
-    write_chrome_trace(target(own), tracer, pid=int(process_index))
-    if int(process_index) == 0:
-        lanes = sorted(glob.glob(os.path.join(directory,
-                                              "trace_[0-9]*.json")))
-        merge_chrome_traces(lanes, target(merged_path))
-    return own
+    writing = merged_path  # the file in flight when a failure hits
+    try:
+        if process_count <= 1:
+            return write_chrome_trace(target(merged_path), tracer)
+        own = os.path.join(directory,
+                           f"trace_{int(process_index):04d}.json")
+        writing = own
+        write_chrome_trace(target(own), tracer, pid=int(process_index))
+        if int(process_index) == 0:
+            lanes = sorted(glob.glob(os.path.join(directory,
+                                                  "trace_[0-9]*.json")))
+            writing = merged_path
+            merge_chrome_traces(lanes, target(merged_path))
+        return own
+    except (OSError, ValueError) as err:
+        log.warning("trace export of %r failed: %s", writing, err)
+        from .ledger import _obs_write_failed
+
+        _obs_write_failed("trace", writing, err)
+        return None

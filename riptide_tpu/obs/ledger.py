@@ -34,7 +34,7 @@ import os
 import subprocess
 from datetime import datetime, timezone
 
-from ..utils import envflags
+from ..utils import envflags, fsio
 
 log = logging.getLogger("riptide_tpu.obs.ledger")
 
@@ -162,16 +162,15 @@ def make_row(kind, decomposition, nchunks=None, bound_counts=None,
 def append_row(row, path):
     """Append one row to ``path`` as a single fsync'd JSONL write (the
     journal's atomic-append discipline: concurrent writers interleave
-    whole lines, a kill tears at most the final line)."""
+    whole lines, a kill tears at most the final line — and the fsio
+    append first heals a torn tail left by a prior kill, so the torn
+    fragment is confined to its own dropped line instead of eating
+    this row too). Rows stay plain JSON lines — no checksum suffix —
+    so every existing ledger consumer keeps parsing them raw; the
+    report readers tolerate suffixed rows anyway should that change."""
     parent = os.path.dirname(os.path.abspath(path))
     os.makedirs(parent, exist_ok=True)
-    data = (json.dumps(row, separators=(",", ":")) + "\n").encode()
-    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-    try:
-        os.write(fd, data)
-        os.fsync(fd)
-    finally:
-        os.close(fd)
+    fsio.append_jsonl(path, [row], site="ledger_append", checksum=False)
     return path
 
 
@@ -189,10 +188,29 @@ def maybe_append(kind, decomposition, nchunks=None, bound_counts=None,
     try:
         append_row(row, path)
     except OSError as err:
+        # The hard invariant: observability writes are never fatal. A
+        # full disk or failing fsync degrades to an incident + counter
+        # and the run it was recording completes.
         log.warning("ledger append to %r failed: %s", path, err)
+        _obs_write_failed("ledger", path, err)
         return None
     log.info("ledger: appended %s row to %s", kind, path)
     return path
+
+
+def _obs_write_failed(op, path, err):
+    """Incident + ``obs_write_errors`` counter for a degraded
+    observability write (imports deferred: obs modules must not pull
+    the survey layer — or jax — at import time)."""
+    try:
+        from ..survey.incidents import emit
+        from ..survey.metrics import get_metrics
+
+        get_metrics().add("obs_write_errors")
+        emit("obs_write_failed", op=op, path=os.path.basename(str(path)),
+             error=str(err))
+    except Exception as err2:  # pragma: no cover - advisory path
+        log.warning("obs_write_failed incident emission failed: %s", err2)
 
 
 def read_rows(path):

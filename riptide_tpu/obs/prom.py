@@ -39,7 +39,7 @@ import os
 import threading
 
 from ..survey.metrics import get_metrics
-from ..utils import envflags
+from ..utils import envflags, fsio
 
 log = logging.getLogger("riptide_tpu.obs.prom")
 
@@ -63,6 +63,7 @@ _HELP = {
     "peer_losses": "collectives degraded to local-only mode",
     "oom_bisections": "DM-batch halvings after device OOM",
     "incidents": "structured incident records emitted",
+    "obs_write_errors": "observability writes degraded to incidents",
     "wire_bytes": "bytes shipped over the host->device wire",
     "queue_depth": "work items not yet collected",
     "heartbeat_age_s": "age of the stalest peer heartbeat",
@@ -129,21 +130,28 @@ def render(registry=None):
 
 def write_prom(path, registry=None):
     """Atomically write the exposition page to ``path`` (textfile-
-    collector format: a scraper never reads a torn page)."""
-    tmp = f"{path}.tmp"
-    with open(tmp, "w") as fobj:
-        fobj.write(render(registry))
-    os.replace(tmp, path)
-    return path
+    collector format: tmp + fsync + rename + directory fsync via fsio —
+    a scraper never reads a torn page, even across a machine crash)."""
+    return fsio.atomic_write_text(path, render(registry),
+                                  site="prom_textfile")
 
 
 def maybe_write_textfile(registry=None):
     """Write the page to ``RIPTIDE_PROM_TEXTFILE`` when set (end-of-run
-    hook of the survey scheduler and rseek); returns the path or None."""
+    hook of the survey scheduler and rseek); returns the path or None.
+    Never fatal: a failed write degrades to an ``obs_write_failed``
+    incident + ``obs_write_errors`` counter and the run completes."""
     path = envflags.get("RIPTIDE_PROM_TEXTFILE")
     if not path:
         return None
-    return write_prom(path, registry)
+    try:
+        return write_prom(path, registry)
+    except OSError as err:
+        log.warning("prom textfile write to %r failed: %s", path, err)
+        from .ledger import _obs_write_failed
+
+        _obs_write_failed("prom_textfile", path, err)
+        return None
 
 
 # Process-wide live-status provider: a zero-argument callable returning

@@ -32,6 +32,7 @@ a running survey or auditing a ledger never needs a jax install.
 import glob
 import json
 import os
+import zlib
 
 __all__ = [
     "PHASE_SUM_TOL", "SERIAL_PHASES", "JournalFollower", "read_journal",
@@ -39,7 +40,7 @@ __all__ = [
     "load_trace_summary", "run_decomposition_from_chunks",
     "phase_attribution", "stragglers", "tunnel_stats", "build_report",
     "render_text", "compare_to_ledger", "latest_platform",
-    "drop_own_row",
+    "drop_own_row", "strip_checksum", "parse_record_line",
 ]
 
 # Relative tolerance on |sum(serial phases) - chunk_s| (the acceptance
@@ -61,11 +62,49 @@ TUNNEL_KNEE_MBPS = 25.0
 
 
 # ---------------------------------------------------------------- reading
+#
+# The ONE lenient-line discipline every reader here applies, to every
+# input (journal, ledger, trace, prom textfile): strip a per-record
+# CRC32 suffix when present (`` #xxxxxxxx`` after the payload — the
+# journal's crash-safety framing; a mismatching CRC means the record's
+# bytes changed after they were written and the record is DROPPED, not
+# half-trusted), tolerate records without one (pre-checksum files), and
+# skip torn/garbage lines entirely. Reimplemented here rather than
+# imported from utils/fsio so this module stays loadable standalone by
+# file path (rreport/rtop on a jax-less login node).
+
+_HEXDIGITS = frozenset(b"0123456789abcdef")
+
+
+def strip_checksum(line):
+    """``(payload, ok)`` of one record line (bytes): the `` #crc32``
+    suffix removed when present. ``ok`` is False only when a suffix is
+    present and its CRC does not match — a corrupted record the caller
+    must drop. Suffix-less lines pass through unchanged (ok=True)."""
+    if len(line) > 10 and line[-10:-8] == b" #" \
+            and all(c in _HEXDIGITS for c in line[-8:]):
+        payload = line[:-10]
+        ok = line[-8:].decode() == format(
+            zlib.crc32(payload) & 0xFFFFFFFF, "08x")
+        return payload, ok
+    return line, True
+
+
+def parse_record_line(line):
+    """One lenient record parse: checksum-stripped/verified JSON, or
+    None for a torn, garbage or corrupt line."""
+    payload, ok = strip_checksum(line.strip())
+    if not ok:
+        return None
+    try:
+        return json.loads(payload)
+    except ValueError:
+        return None
+
 
 def _read_jsonl(path):
-    """Parsed objects of every complete line; torn/garbage lines are
-    dropped (the journal's own tolerance, reimplemented here so the
-    reader stays importable without the package)."""
+    """Parsed objects of every valid complete line; torn/garbage/
+    corrupt lines are dropped (see :func:`parse_record_line`)."""
     if not os.path.exists(path):
         return []
     with open(path, "rb") as fobj:
@@ -74,10 +113,9 @@ def _read_jsonl(path):
     for line in raw.split(b"\n"):
         if not line.strip():
             continue
-        try:
-            out.append(json.loads(line))
-        except ValueError:
-            pass
+        obj = parse_record_line(line)
+        if obj is not None:
+            out.append(obj)
     return out
 
 
@@ -147,10 +185,9 @@ class JournalFollower:
             for line in raw[:end].split(b"\n"):
                 if not line.strip():
                     continue
-                try:
-                    self._fold(json.loads(line))
-                except ValueError:
-                    pass
+                obj = parse_record_line(line)
+                if obj is not None:
+                    self._fold(obj)
             self._offset += end + 1
         parked = {cid: rec for cid, rec in self._parked.items()
                   if cid not in self._chunks}
@@ -183,10 +220,7 @@ def read_heartbeats(journal_dir, tail_bytes=4096):
         except OSError:
             continue
         for line in reversed([l for l in tail.split(b"\n") if l.strip()]):
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
+            rec = parse_record_line(line)
             if isinstance(rec, dict) and "ts" in rec:
                 out[int(rec.get("process", -1))] = float(rec["ts"])
                 break
@@ -200,16 +234,21 @@ def read_ledger(path):
 
 def parse_prom_text(text):
     """``{series_name: {label_string_or_'': value}}`` from a Prometheus
-    text-format page (permissive; HELP/TYPE lines are skipped)."""
+    text-format page (permissive: HELP/TYPE lines are skipped, torn or
+    garbage lines are dropped, and a checksum-suffixed line is stripped
+    first — the same lenient-line discipline as the JSONL readers)."""
     values = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
+        stripped, ok = strip_checksum(line.encode())
+        if not ok:
+            continue
         try:
-            lhs, val = line.rsplit(None, 1)
+            lhs, val = stripped.decode().rsplit(None, 1)
             name, _, labels = lhs.partition("{")
             values.setdefault(name, {})[labels.rstrip("}")] = float(val)
-        except ValueError:
+        except (ValueError, UnicodeDecodeError):
             pass
     return values
 

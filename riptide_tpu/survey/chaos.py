@@ -1,0 +1,489 @@
+"""
+Seeded storage-chaos campaign: prove kill-anywhere resume, end to end.
+
+The journal's crash-safety story (torn-tail truncation, per-record
+checksums, orphan-peak reconciliation, the observability-writes-are-
+never-fatal invariant) is only a story until a process has actually
+died at every interesting boundary and come back. This module is the
+harness that makes it so: each *schedule* runs a tiny deterministic CPU
+survey as a sequence of subprocess *legs*, with storage faults
+(:mod:`riptide_tpu.survey.faults` storage kinds, injected through the
+:mod:`riptide_tpu.utils.fsio` layer) arming mid-write kills, torn
+writes, ENOSPC, fsync failures and cache corruption — then restarts
+with ``--resume`` and asserts the end state:
+
+* ``peaks.csv`` is **byte-identical** to the fault-free control run's;
+* the resumed journal is consistent: exactly one chunk record per
+  chunk, no torn/corrupt lines left, phase timings summing within the
+  report tolerance, and a peak store with no orphaned rows;
+* the perf ledger holds a valid row for the completed run (whatever
+  leg finally completed it — a run killed mid-ledger-append still owes
+  its row after resume);
+* every injected fault left an **incident record** in the journal
+  (``storage_recovered`` for recovered kills/tears,
+  ``obs_write_failed`` for degraded observability writes,
+  ``cache_corrupt`` for an evicted executable-cache entry);
+* no leg printed a traceback: expected kills exit ``fsio.KILL_EXIT``,
+  everything else exits 0.
+
+The control schedule additionally asserts the hardening is
+byte-transparent for healthy runs: re-running recovery and the report
+readers over its artifacts leaves journal, peak store and ledger
+byte-for-byte unchanged (recovery only ever mutates damaged files),
+and ledger rows remain plain JSON lines.
+
+:func:`builtin_schedules` is the small fixed set ``make chaos`` runs
+(CI-speed); :func:`seeded_schedules` derives arbitrarily many extra
+kill-point/degradation combinations from a seed for the fuller sweep
+(``tools/rchaos.py --sweep N``, or the slow-marked test).
+
+Subprocess legs re-enter this module via
+``python -m riptide_tpu.survey.chaos --leg <cfg.json>``.
+"""
+import json
+import logging
+import os
+import random
+import shutil
+import subprocess
+import sys
+
+from ..utils import envflags, fsio
+
+log = logging.getLogger("riptide_tpu.survey.chaos")
+
+__all__ = ["builtin_schedules", "seeded_schedules", "run_campaign",
+           "ChaosFailure", "SEARCH_CONF", "TOBS", "TSAMP", "PERIOD"]
+
+# The tiny deterministic survey every schedule runs: three single-file
+# DM-trial chunks, small enough that a whole multi-leg schedule stays
+# in CI-compatible time on the CPU backend.
+TOBS, TSAMP, PERIOD = 12.0, 1e-3, 0.5
+DMS = (0.0, 5.0, 10.0)
+AMPLITUDE = 30.0
+
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+
+class ChaosFailure(AssertionError):
+    """A chaos schedule violated one of the campaign's invariants."""
+
+
+def default_workdir():
+    """Campaign working directory: ``RIPTIDE_CHAOS_DIR`` or a fixed
+    tempdir (kept on failure for post-mortems; see ``rchaos --keep``)."""
+    import tempfile
+
+    return envflags.get("RIPTIDE_CHAOS_DIR") or os.path.join(
+        tempfile.gettempdir(), "riptide_chaos")
+
+
+def default_keep():
+    """Whether to keep the working directory after a PASSING campaign
+    (``RIPTIDE_CHAOS_KEEP``; failures always keep it)."""
+    return bool(envflags.get("RIPTIDE_CHAOS_KEEP"))
+
+
+# --------------------------------------------------------------- schedules
+
+def builtin_schedules():
+    """The fixed schedule set of ``make chaos``. ``control`` must (and
+    does) come first: it produces the reference ``peaks.csv`` bytes and
+    the byte-transparency assertions. Journal-append operation indices
+    on the clean path: 1 = header, 2-4 = chunk records, 5 = metrics."""
+    return [
+        {"name": "control", "legs": [{"faults": ""}], "incidents": []},
+        {"name": "kill-journal-append",
+         "legs": [{"faults": "kill_at:journal_append:3", "expect": "kill"},
+                  {"faults": "", "resume": True}],
+         "incidents": ["storage_recovered"]},
+        {"name": "torn-journal-tail",
+         "legs": [{"faults": "kill_at:journal_append:5", "expect": "kill"},
+                  {"faults": "", "resume": True}],
+         "incidents": ["storage_recovered"]},
+        {"name": "kill-peaks-append",
+         "legs": [{"faults": "kill_at:peaks_append:2", "expect": "kill"},
+                  {"faults": "", "resume": True}],
+         "incidents": ["storage_recovered"]},
+        {"name": "kill-ledger-append",
+         "legs": [{"faults": "kill_at:ledger_append:1", "expect": "kill"},
+                  {"faults": "", "resume": True}],
+         "incidents": ["storage_recovered"]},
+        {"name": "enospc-trace-export",
+         "legs": [{"faults": "enospc:trace_export", "trace": True}],
+         "incidents": ["obs_write_failed"]},
+        {"name": "fsync-fail-heartbeat",
+         "legs": [{"faults": "fsync_fail:heartbeat_append"}],
+         "incidents": ["obs_write_failed"]},
+        {"name": "enospc-prom-textfile",
+         "legs": [{"faults": "enospc:prom_textfile", "prom": True}],
+         "incidents": ["obs_write_failed"]},
+        {"name": "cache-corrupt",
+         "legs": [{"faults": "cache_corrupt:exec_cache_store:1",
+                   "cache_probe": True, "cache_expect": "compiled"},
+                  {"faults": "", "resume": True, "cache_probe": True,
+                   "cache_expect": "compiled", "cache_reload": True}],
+         "incidents": ["cache_corrupt"]},
+    ]
+
+
+def seeded_schedules(seed, count):
+    """``count`` extra schedules derived deterministically from
+    ``seed``: a mid-write kill at a random journal/peaks/ledger
+    boundary, then a resume leg carrying a random observability-write
+    degradation — every combination must still end byte-identical with
+    its incidents recorded. Same seed, same schedules, so a failing
+    sweep entry reproduces by name."""
+    rng = random.Random(int(seed))
+    kills = [("journal_append", 1, 5), ("peaks_append", 1, 3),
+             ("ledger_append", 1, 1)]
+    degradations = [
+        ("enospc", "trace_export", {"trace": True}),
+        ("fsync_fail", "trace_export", {"trace": True}),
+        ("enospc", "prom_textfile", {"prom": True}),
+        ("fsync_fail", "prom_textfile", {"prom": True}),
+        ("torn_write", "ledger_append", {}),
+        ("enospc", "heartbeat_append", {}),
+        ("fsync_fail", "heartbeat_append", {}),
+    ]
+    out = []
+    for i in range(int(count)):
+        site, lo, hi = rng.choice(kills)
+        nth = rng.randint(lo, hi)
+        # A kill at/after the last journal record leaves no pending
+        # chunks, so the resume leg replays everything and never
+        # heartbeats — heartbeat degradations would go unfired there.
+        replays_all = site == "ledger_append" or \
+            (site == "journal_append" and nth == 5)
+        pool = [d for d in degradations
+                if not (replays_all and d[1] == "heartbeat_append")]
+        kind2, site2, legopts = rng.choice(pool)
+        resume_leg = dict({"faults": f"{kind2}:{site2}", "resume": True},
+                          **legopts)
+        legs = [{"faults": f"kill_at:{site}:{nth}", "expect": "kill"},
+                resume_leg]
+        if site2 == "ledger_append":
+            # The degradation destroyed the completing leg's ONLY
+            # ledger append (the kill already ate leg 1's); a final
+            # clean resume must recover the row from the journaled
+            # timings — exactly the replay-derived-row path.
+            legs.append({"faults": "", "resume": True})
+        out.append({
+            "name": f"seeded-{int(seed)}-{i:02d}",
+            "legs": legs,
+            "incidents": ["storage_recovered", "obs_write_failed"],
+        })
+    return out
+
+
+# ------------------------------------------------------------ the campaign
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def _run_leg(schedule, i, leg, paths, python, timeout_s):
+    cfg = {
+        "journal": paths["jdir"],
+        "files": paths["files"],
+        "faults": leg.get("faults", ""),
+        "resume": bool(leg.get("resume", False)),
+        "peaks_csv": paths["peaks_csv"],
+        "trace": bool(leg.get("trace", False)),
+        "cache_probe": bool(leg.get("cache_probe", False)),
+        "cache_dir": paths["cache_dir"],
+        "cache_expect": leg.get("cache_expect"),
+        "cache_reload": bool(leg.get("cache_reload", False)),
+    }
+    cfg_path = os.path.join(paths["sdir"], f"leg{i}.json")
+    with open(cfg_path, "w") as fobj:
+        json.dump(cfg, fobj, indent=1)
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    for name in ("RIPTIDE_FAULT_INJECT", "RIPTIDE_TRACE",
+                 "RIPTIDE_PROM_TEXTFILE", "RIPTIDE_PROM_PORT"):
+        env.pop(name, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RIPTIDE_LEDGER"] = paths["ledger"]
+    # Compiled search programs repeat identically across legs; the jax
+    # persistent cache keeps every leg after the first to ~import cost.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   "/tmp/riptide_tpu_jax_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    if leg.get("prom"):
+        env["RIPTIDE_PROM_TEXTFILE"] = os.path.join(paths["sdir"],
+                                                    "metrics.prom")
+    proc = subprocess.run(
+        [python, "-m", "riptide_tpu.survey.chaos", "--leg", cfg_path],
+        env=env, cwd=_repo_root(), capture_output=True, text=True,
+        timeout=float(timeout_s),
+    )
+    expect = leg.get("expect", "ok")
+    want_rc = fsio.KILL_EXIT if expect == "kill" else 0
+    tail = "\n".join(proc.stderr.splitlines()[-15:])
+    if proc.returncode != want_rc:
+        raise ChaosFailure(
+            f"schedule {schedule['name']!r} leg {i} "
+            f"(faults={leg.get('faults', '')!r}) exited "
+            f"{proc.returncode}, expected {want_rc}:\n{tail}"
+        )
+    if "Traceback (most recent call last)" in proc.stderr:
+        raise ChaosFailure(
+            f"schedule {schedule['name']!r} leg {i} raised an uncaught "
+            f"exception:\n{tail}"
+        )
+
+
+def _valid_records(path):
+    """Parsed records of every good line; raises on torn/corrupt lines
+    (a FINAL journal must be fully valid — the last leg completed)."""
+    entries, _ = fsio.scan_jsonl(path)
+    bad = [status for obj, status, _ in entries if obj is None]
+    if bad:
+        raise ChaosFailure(f"{path}: {len(bad)} invalid line(s) "
+                           f"({bad}) in a completed run's file")
+    return [obj for obj, _, _ in entries]
+
+
+def _check_schedule(schedule, paths):
+    """The post-schedule invariants (see the module docstring)."""
+    from ..obs import report
+
+    name = schedule["name"]
+    recs = _valid_records(os.path.join(paths["jdir"], "journal.jsonl"))
+    chunk_ids = [int(r["chunk_id"]) for r in recs
+                 if r.get("kind") == "chunk"]
+    nchunks = len(paths["files"])
+    if sorted(set(chunk_ids)) != list(range(nchunks)):
+        raise ChaosFailure(f"{name}: journal completed chunks "
+                           f"{sorted(set(chunk_ids))}, expected "
+                           f"{list(range(nchunks))}")
+    if len(chunk_ids) != len(set(chunk_ids)):
+        raise ChaosFailure(f"{name}: duplicate chunk records after "
+                           f"resume: {sorted(chunk_ids)}")
+    last = {int(r["chunk_id"]): r for r in recs
+            if r.get("kind") == "chunk"}
+    _, violations = report.phase_attribution(last)
+    if violations:
+        raise ChaosFailure(f"{name}: phase-sum violations {violations}")
+    rows = _valid_records(os.path.join(paths["jdir"], "peaks.jsonl"))
+    claimed = sum(int(r.get("peaks_count", 0)) for r in last.values())
+    if len(rows) != claimed:
+        raise ChaosFailure(
+            f"{name}: peak store holds {len(rows)} rows but chunk "
+            f"records claim {claimed} (orphaned or missing rows)")
+    survey_id = next((r.get("survey_id") for r in recs
+                      if r.get("kind") == "header"), None)
+    ledger_rows = [r for r in report.read_ledger(paths["ledger"])
+                   if r.get("kind") == "survey"
+                   and r.get("survey_id") == survey_id]
+    if not ledger_rows:
+        raise ChaosFailure(f"{name}: no ledger row for the completed "
+                           f"run (survey {survey_id})")
+    seen = {r.get("incident") for r in recs if r.get("kind") == "incident"}
+    missing = [k for k in schedule.get("incidents", ()) if k not in seen]
+    if missing:
+        raise ChaosFailure(f"{name}: expected incident kind(s) "
+                           f"{missing} not recorded (saw {sorted(seen)})")
+    with open(paths["peaks_csv"], "rb") as fobj:
+        return fobj.read(), len(recs)
+
+
+def _check_control_stability(paths):
+    """The hardening is byte-transparent for healthy runs: recovery
+    plus a full report pass over the control run's artifacts changes
+    nothing, and ledger rows are plain (checksum-less) JSON lines."""
+    from ..obs import report
+    from .journal import SurveyJournal
+
+    targets = [os.path.join(paths["jdir"], "journal.jsonl"),
+               os.path.join(paths["jdir"], "peaks.jsonl"),
+               paths["ledger"]]
+    before = {}
+    for path in targets:
+        with open(path, "rb") as fobj:
+            before[path] = fobj.read()
+    for line in before[paths["ledger"]].splitlines():
+        if line.strip():
+            json.loads(line)  # raw-parseable: no suffix, no framing
+    journal = SurveyJournal(paths["jdir"])
+    journal.recover()
+    report.build_report(paths["jdir"])
+    journal.completed_chunks()
+    for path in targets:
+        with open(path, "rb") as fobj:
+            if fobj.read() != before[path]:
+                raise ChaosFailure(
+                    f"control: {path} bytes changed by a recovery/"
+                    "report pass over a healthy run")
+
+
+def run_campaign(files, workdir, schedules=None, python=None,
+                 timeout_s=300.0):
+    """Run every schedule (default: :func:`builtin_schedules` plus
+    ``RIPTIDE_CHAOS_SWEEP`` seeded ones under ``RIPTIDE_CHAOS_SEED``)
+    against the pre-generated survey ``files``, asserting the
+    campaign's invariants; raises :class:`ChaosFailure` on the first
+    violation. Returns a summary dict."""
+    python = python or sys.executable
+    if schedules is None:
+        schedules = builtin_schedules() + seeded_schedules(
+            envflags.get("RIPTIDE_CHAOS_SEED"),
+            envflags.get("RIPTIDE_CHAOS_SWEEP"))
+    schedules = list(schedules)
+    if not schedules or schedules[0]["name"] != "control":
+        schedules.insert(0, builtin_schedules()[0])
+    ref_bytes = None
+    legs_run = 0
+    for schedule in schedules:
+        sdir = os.path.join(workdir, schedule["name"])
+        shutil.rmtree(sdir, ignore_errors=True)
+        os.makedirs(sdir)
+        paths = {
+            "sdir": sdir,
+            "jdir": os.path.join(sdir, "j"),
+            "ledger": os.path.join(sdir, "ledger.jsonl"),
+            "peaks_csv": os.path.join(sdir, "peaks.csv"),
+            "cache_dir": os.path.join(sdir, "cache"),
+            "files": [os.path.abspath(f) for f in files],
+        }
+        for i, leg in enumerate(schedule["legs"]):
+            _run_leg(schedule, i, leg, paths, python, timeout_s)
+            legs_run += 1
+        peaks_bytes, nrecords = _check_schedule(schedule, paths)
+        if schedule["name"] == "control":
+            ref_bytes = peaks_bytes
+            _check_control_stability(paths)
+        elif peaks_bytes != ref_bytes:
+            raise ChaosFailure(
+                f"{schedule['name']}: peaks.csv differs from the "
+                f"fault-free control run ({len(peaks_bytes)} vs "
+                f"{len(ref_bytes)} bytes)")
+        log.info("chaos schedule %-24s OK (%d leg(s), %d journal "
+                 "records)", schedule["name"], len(schedule["legs"]),
+                 nrecords)
+    return {"schedules": len(schedules), "legs": legs_run,
+            "peaks_csv_bytes": len(ref_bytes or b"")}
+
+
+# ------------------------------------------------------------ the leg side
+
+def _write_peaks_csv(peaks, path):
+    """The campaign's data product: the pipeline's peaks.csv
+    serialization (one row per peak, 9-decimal floats) so byte-identity
+    here means byte-identity in the real product too."""
+    import pandas
+
+    if not peaks:
+        with open(path, "w") as fobj:
+            fobj.write("")
+        return
+    pandas.DataFrame.from_dict(
+        [p.summary_dict() for p in peaks]
+    ).to_csv(path, sep=",", index=False, float_format="%.9f")
+
+
+def _cache_probe(cache_dir, expect=None, reload_check=False):
+    """Exercise the executable cache's corruption recovery inside a
+    leg: one tiny jitted program through ``load_or_compile_exec`` at a
+    fixed path. A ``cache_corrupt`` storage fault flips a byte of the
+    stored entry; the NEXT leg's probe must detect the bad CRC, emit
+    the incident (journaled — the leg installs the journal sink first),
+    evict, rebuild, and still produce identical results.
+    ``reload_check`` (the recovery leg only — a corruption leg would
+    just re-detect its own injected damage) additionally asserts the
+    rebuilt entry loads back cleanly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..utils import exec_cache
+
+    path = os.path.join(cache_dir, "probe.pkl")
+    jitted = jax.jit(lambda x: x * 2.0 + 1.0)
+    args = (jnp.arange(8.0),)
+    want = np.arange(8.0) * 2.0 + 1.0
+
+    info = {}
+    fn = exec_cache.load_or_compile_exec(path, jitted, args,
+                                         name="chaos_probe", info=info)
+    if not np.allclose(np.asarray(fn(*args)), want):
+        raise ChaosFailure("cache probe produced wrong results")
+    if expect is not None and info["action"] != expect:
+        raise ChaosFailure(f"cache probe action {info['action']!r}, "
+                           f"expected {expect!r}")
+    if reload_check:
+        info = {}
+        fn = exec_cache.load_or_compile_exec(path, jitted, args,
+                                             name="chaos_probe",
+                                             info=info)
+        if info["action"] != "loaded" or \
+                not np.allclose(np.asarray(fn(*args)), want):
+            raise ChaosFailure(
+                f"cache probe re-load after rebuild: action "
+                f"{info['action']!r}")
+
+
+def _leg_main(cfg_path):
+    """One subprocess leg: install the leg's fault plan into fsio and
+    the journal as the incident sink, optionally probe the exec cache,
+    run the tiny survey through the checkpointed scheduler, and write
+    peaks.csv. Exits by returning 0 — unless an armed ``kill_at``
+    hard-exits mid-write first, which is the point."""
+    with open(cfg_path) as fobj:
+        cfg = json.load(fobj)
+
+    from ..obs import trace
+    from ..pipeline.batcher import BatchSearcher
+    from . import incidents
+    from .faults import FaultPlan
+    from .journal import SurveyJournal
+    from .scheduler import RetryPolicy, SurveyScheduler
+
+    logging.basicConfig(level="INFO")
+    if cfg.get("trace"):
+        trace.enable()
+    faults = FaultPlan.parse(cfg.get("faults") or "")
+    prev_hook = fsio.set_storage_faults(faults.storage_op)
+    journal = SurveyJournal(cfg["journal"])
+    prev_sink = incidents.set_sink(journal.record_incident)
+    try:
+        if cfg.get("cache_probe"):
+            os.makedirs(cfg["cache_dir"], exist_ok=True)
+            _cache_probe(cfg["cache_dir"], expect=cfg.get("cache_expect"),
+                         reload_check=bool(cfg.get("cache_reload")))
+        searcher = BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                                 SEARCH_CONF, fmt="presto", io_threads=1)
+        scheduler = SurveyScheduler(
+            searcher, [[f] for f in cfg["files"]], journal=journal,
+            resume=bool(cfg.get("resume")), faults=faults,
+            retry=RetryPolicy(max_retries=2, base_s=0.01, cap_s=0.05),
+        )
+        peaks = scheduler.run()
+        _write_peaks_csv(peaks, cfg["peaks_csv"])
+    finally:
+        incidents.set_sink(prev_sink)
+        fsio.set_storage_faults(prev_hook)
+    return 0
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="chaos-campaign subprocess leg runner (drive whole "
+                    "campaigns via tools/rchaos.py)")
+    parser.add_argument("--leg", required=True,
+                        help="Path of the leg-config JSON written by "
+                             "run_campaign")
+    args = parser.parse_args(argv)
+    return _leg_main(args.leg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

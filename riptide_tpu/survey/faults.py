@@ -44,14 +44,40 @@ Spec grammar: comma-separated directives, each
   the multihost layer degrades to local-only mode (see
   riptide_tpu/parallel/multihost.py).
 
+**Storage faults** target a persistence *site* (a name from
+:data:`riptide_tpu.utils.fsio.SITES`) instead of a chunk id, and fire
+through the fsio layer's hook (the survey layers install the plan's
+:meth:`FaultPlan.storage_op` for the run's duration). The optional
+``:n`` selects the n-th write-class operation on that site (1-based,
+default 1); ``xN`` keeps firing for N consecutive operations from
+there —
+
+* ``kill_at:journal_append:3``  write HALF of the third journal append
+  then hard-exit the process (exit ``fsio.KILL_EXIT``): the chaos
+  campaign's kill points, leaving a genuinely torn tail for resume to
+  recover;
+* ``torn_write:ledger_append``  write a partial record then raise
+  ``EIO`` (the device reported failure after a partial transfer) —
+  observability paths must degrade to an incident, not die;
+* ``enospc:trace_export``       raise ``ENOSPC`` before writing;
+* ``fsync_fail:heartbeat_append``  the write lands but its fsync
+  raises ``EIO``;
+* ``cache_corrupt:exec_cache_store``  flip a byte of the placed
+  executable-cache entry (detected by the loader's CRC on the next
+  process's load: incident, evict, rebuild).
+
 Example: ``RIPTIDE_FAULT_INJECT="stall:0:0.1,raise:2x2,oom:0"``.
 """
+import errno
 import logging
+import os
+import re
 import threading
 import time
 
 import numpy as np
 
+from ..utils import fsio
 from .liveness import PeerTimeout
 
 __all__ = ["FaultPlan", "FaultAbort", "InjectedFault", "InjectedOOM",
@@ -60,7 +86,25 @@ __all__ = ["FaultPlan", "FaultAbort", "InjectedFault", "InjectedOOM",
 log = logging.getLogger("riptide_tpu.survey.faults")
 
 _KINDS = ("raise", "stall", "corrupt", "abort", "nan_inject", "oom",
-          "hang", "straggle", "peer_loss")
+          "hang", "straggle", "peer_loss",
+          "torn_write", "enospc", "fsync_fail", "kill_at",
+          "cache_corrupt")
+
+# Directive kinds whose second field is a persistence SITE (string from
+# fsio.SITES) rather than a chunk id, consumed via storage_op().
+_STORAGE_KINDS = ("torn_write", "enospc", "fsync_fail", "kill_at",
+                  "cache_corrupt")
+
+# Which fsio operation each storage kind fires on.
+_STORAGE_TRIGGER_OP = {
+    "torn_write": "write",
+    "enospc": "write",
+    "kill_at": "write",
+    "fsync_fail": "fsync",
+    "cache_corrupt": "placed",
+}
+
+_TIMES_RE = re.compile(r"x(\d+)$")
 
 
 class InjectedFault(RuntimeError):
@@ -98,18 +142,27 @@ class InjectedOOM(RuntimeError):
 
 class FaultPlan:
     """Parsed fault directives, consumed as the scheduler/batcher hits
-    their trigger points. ``sleep`` is injectable for tests. Trigger
-    methods are thread-safe: the batcher's loader pool fires
-    ``nan_inject`` concurrently."""
+    their trigger points. ``sleep`` is injectable for tests, as is
+    ``exit`` (the hard-kill primitive of ``kill_at`` storage faults —
+    ``os._exit`` in production, a raising stub in-process tests).
+    Trigger methods are thread-safe: the batcher's loader pool fires
+    ``nan_inject`` concurrently and fsio announces storage operations
+    from whichever thread is persisting."""
 
-    def __init__(self, directives=(), sleep=time.sleep):
-        # directive: dict(kind, chunk, arg, remaining)
+    def __init__(self, directives=(), sleep=time.sleep, exit=os._exit):
+        # directive: dict(kind, chunk, arg, remaining) — storage kinds
+        # carry dict(kind, site, nth, remaining) instead.
         self._directives = [dict(d) for d in directives]
         self._sleep = sleep
+        self._exit = exit
         self._lock = threading.Lock()
+        # Per-site write-class operation counter (1-based after the
+        # first increment) for the storage directives' :n selector.
+        self._site_ops = {}
+        self._has_storage = any("site" in d for d in self._directives)
 
     @classmethod
-    def parse(cls, spec, sleep=time.sleep):
+    def parse(cls, spec, sleep=time.sleep, exit=os._exit):
         """Build a plan from a spec string; None/empty -> inert plan."""
         directives = []
         for part in (spec or "").split(","):
@@ -117,26 +170,47 @@ class FaultPlan:
             if not part:
                 continue
             times = 1
-            if "x" in part.rsplit(":", 1)[-1]:
-                part, _, n = part.rpartition("x")
-                times = int(n)
+            # xN repeat suffix — matched as a trailing x<digits> so
+            # site names containing an 'x' (trace_export) never parse
+            # as repeats.
+            m = _TIMES_RE.search(part.rsplit(":", 1)[-1])
+            if m:
+                part = part[: -len(m.group(0))]
+                times = int(m.group(1))
             bits = part.split(":")
             if len(bits) < 2 or bits[0] not in _KINDS:
                 raise ValueError(
                     f"bad fault directive {part!r}: expected "
                     f"kind:chunk[:arg][xN] with kind in {_KINDS}"
                 )
-            kind, chunk = bits[0], int(bits[1])
+            kind = bits[0]
+            if kind in _STORAGE_KINDS:
+                site = bits[1]
+                if site not in fsio.SITES:
+                    raise ValueError(
+                        f"bad fault directive {part!r}: {site!r} is not "
+                        f"a storage site (expected one of {fsio.SITES})"
+                    )
+                nth = int(bits[2]) if len(bits) > 2 else 1
+                if nth < 1:
+                    raise ValueError(
+                        f"bad fault directive {part!r}: the operation "
+                        "index is 1-based"
+                    )
+                directives.append({"kind": kind, "site": site,
+                                   "nth": nth, "remaining": times})
+                continue
+            chunk = int(bits[1])
             arg = float(bits[2]) if len(bits) > 2 else None
             directives.append(
                 {"kind": kind, "chunk": chunk, "arg": arg, "remaining": times}
             )
-        return cls(directives, sleep=sleep)
+        return cls(directives, sleep=sleep, exit=exit)
 
     def _take(self, kind, chunk_id):
         with self._lock:
             for d in self._directives:
-                if d["kind"] == kind and d["chunk"] == chunk_id \
+                if d["kind"] == kind and d.get("chunk") == chunk_id \
                         and d["remaining"] > 0:
                     d["remaining"] -= 1
                     return d
@@ -247,3 +321,68 @@ class FaultPlan:
         log.warning("fault injection: device OOM on a %d-trial batch",
                     batch_size)
         raise InjectedOOM(batch_size, floor)
+
+    # -- trigger points (called by the fsio layer) --------------------------
+
+    def storage_op(self, op, site, path=None):
+        """The storage fault hook fsio announces every persistence
+        operation to (installed process-wide via
+        ``fsio.set_storage_faults`` by the survey layers for a run's
+        duration). ``op`` is ``write``/``fsync``/``placed``; write-class
+        operations advance the per-site counter the directives' ``:n``
+        selector indexes. Decisions are taken under the plan lock;
+        ACTIONS (raising, killing, corrupting) run outside it."""
+        if not self._has_storage:
+            return None
+        actions = []
+        with self._lock:
+            if op == "write":
+                self._site_ops[site] = self._site_ops.get(site, 0) + 1
+            cur = self._site_ops.get(site, 0)
+            for d in self._directives:
+                if d.get("site") != site or d["remaining"] <= 0:
+                    continue
+                if _STORAGE_TRIGGER_OP[d["kind"]] != op or cur < d["nth"]:
+                    continue
+                d["remaining"] -= 1
+                actions.append(d["kind"])
+        cmd = None
+        for kind in actions:
+            if kind == "enospc":
+                log.warning("fault injection: ENOSPC at %s (%s)",
+                            site, path)
+                raise OSError(errno.ENOSPC,
+                              f"injected ENOSPC at {site}: {path!r}")
+            if kind == "fsync_fail":
+                log.warning("fault injection: fsync failure at %s (%s)",
+                            site, path)
+                raise OSError(errno.EIO,
+                              f"injected fsync failure at {site}: {path!r}")
+            if kind == "kill_at":
+                log.warning("fault injection: arming mid-write kill at "
+                            "%s (%s)", site, path)
+                cmd = {"torn_frac": 0.5, "exit": self._exit}
+            if kind == "torn_write":
+                log.warning("fault injection: arming torn write at %s "
+                            "(%s)", site, path)
+                cmd = {"torn_frac": 0.5, "exit": None}
+            if kind == "cache_corrupt" and path is not None:
+                self._corrupt_file(site, path)
+        return cmd
+
+    @staticmethod
+    def _corrupt_file(site, path):
+        """Flip the last byte of a just-placed file (simulated bit rot;
+        the exec cache's CRC framing detects it on the next load)."""
+        try:
+            with open(path, "r+b") as fobj:
+                fobj.seek(-1, os.SEEK_END)
+                byte = fobj.read(1)
+                fobj.seek(-1, os.SEEK_END)
+                fobj.write(bytes([byte[0] ^ 0xFF]))
+        except OSError as err:  # pragma: no cover - injection plumbing
+            log.warning("fault injection: could not corrupt %s: %s",
+                        path, err)
+            return
+        log.warning("fault injection: corrupted placed file at %s (%s)",
+                    site, path)

@@ -53,6 +53,10 @@ INCIDENT_KINDS = (
     "oom_bisection",      # batcher: DM batch halved after device OOM
     "quarantine",         # quality: series dropped by the DQ scan
     "peer_loss",          # multihost: degraded to local-only mode
+    "storage_recovered",  # journal/fsio: torn tail truncated or healed
+    "record_corrupt",     # journal: checksum-failed record(s) dropped
+    "obs_write_failed",   # ledger/trace/prom/heartbeat write degraded
+    "cache_corrupt",      # exec cache: corrupt entry evicted + rebuilt
 )
 
 _lock = threading.Lock()

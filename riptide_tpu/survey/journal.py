@@ -31,12 +31,28 @@ all to decide who is alive and who writes the shared journal.
 
 Appends are atomic at the line level: each record is a single
 ``write()`` of one ``\\n``-terminated line on an ``O_APPEND`` fd,
-followed by ``fsync``. The loader tolerates a torn final line (a kill
-mid-append) by ignoring it, and reconciles every chunk record against
-the peak store: a chunk whose claimed ``[peaks_offset, peaks_offset +
-peaks_count)`` rows are missing (the process died between the two
-appends — peaks are written first to make that window detectable) is
-treated as never completed and re-dispatched by the scheduler.
+followed by ``fsync`` (via :mod:`riptide_tpu.utils.fsio`, which is also
+where storage faults inject). Journal and peak-store lines carry a
+per-record CRC32 suffix (`` #xxxxxxxx`` after the JSON payload) so a
+*corrupted* record — bit rot, a lying disk — is distinguishable from a
+*torn* one (kill mid-append); checksum-less lines parse as legacy, so
+journals written before the suffix existed resume unchanged.
+
+Recovery happens once per writing run (:meth:`SurveyJournal.write_header`
+calls :meth:`SurveyJournal.recover`): a torn or corrupt TAIL of either
+file is truncated back to the last good record (appending after a torn
+tail would glue the next record onto the fragment, losing both), and
+peak-store rows beyond every chunk record's claim — the process died
+between the peak append and the chunk record — are truncated too, so a
+re-dispatched chunk re-appends its peaks at the same offsets and the
+final data products stay byte-identical. Both recoveries are
+incident-recorded (``storage_recovered``); corrupt records in the
+MIDDLE of the journal are never truncated, only dropped at read (and
+incident-recorded as ``record_corrupt`` during recovery). The loader
+additionally reconciles every chunk record against the peak store: a
+chunk whose claimed ``[peaks_offset, peaks_offset + peaks_count)`` rows
+are missing is treated as never completed and re-dispatched by the
+scheduler.
 """
 import json
 import logging
@@ -44,6 +60,7 @@ import os
 from datetime import datetime, timezone
 
 from ..peak_detection import PEAK_FIELDS, PEAK_INT_FIELDS, Peak
+from ..utils import fsio
 
 log = logging.getLogger("riptide_tpu.survey.journal")
 
@@ -67,45 +84,33 @@ def _utc_iso():
         + "Z"
 
 
-def _append_lines(path, objs):
+def _append_lines(path, objs, site=None, checksum=True):
     """Append JSON lines in ONE write on an O_APPEND fd, fsync'd once
     before returning — a chunk's whole peak batch costs a single
     open/write/fsync cycle, and each line is still torn-tolerantly
-    parseable on its own."""
-    data = b"".join(
-        (json.dumps(obj, separators=(",", ":")) + "\n").encode()
-        for obj in objs
-    )
-    if not data:
-        return
-    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-    try:
-        os.write(fd, data)
-        os.fsync(fd)
-    finally:
-        os.close(fd)
+    parseable (and, with ``checksum``, corruption-detectable) on its
+    own."""
+    fsio.append_jsonl(path, objs, site=site, checksum=checksum)
 
 
-def _append_line(path, obj):
+def _append_line(path, obj, site=None, checksum=True):
     """Single-write append of one JSON line, fsync'd before returning."""
-    _append_lines(path, [obj])
+    _append_lines(path, [obj], site=site, checksum=checksum)
 
 
 def _read_lines(path):
-    """Parsed JSON objects of every complete line; a torn final line
-    (no trailing newline, or unparseable) is dropped."""
-    if not os.path.exists(path):
-        return []
-    with open(path, "rb") as f:
-        raw = f.read()
+    """Parsed JSON objects of every valid complete line. Torn final
+    lines, unparseable garbage and checksum-failed records are dropped
+    (recovery — which truncates bad tails and incident-records the
+    rest — is a WRITER-side act; reading stays read-only so monitors
+    can share a live journal)."""
     out = []
-    for i, line in enumerate(raw.split(b"\n")):
-        if not line:
-            continue
-        try:
-            out.append(json.loads(line))
-        except ValueError:
-            log.warning("%s: dropping torn record at line %d", path, i + 1)
+    for i, (obj, status, _) in enumerate(fsio.scan_jsonl(path)[0]):
+        if obj is not None and status in ("ok", "legacy"):
+            out.append(obj)
+        else:
+            log.warning("%s: dropping %s record at line %d",
+                        path, status, i + 1)
     return out
 
 
@@ -114,7 +119,8 @@ def _read_last_record(path, tail_bytes=4096):
     only the final ``tail_bytes`` — heartbeat sidecars grow by one line
     per chunk and only the last beat matters, so a full parse would
     make liveness checks O(survey length) each. A torn final line (or
-    a first line truncated by the tail window) is skipped."""
+    a first line truncated by the tail window) is skipped, as are
+    checksum-suffixed records whose CRC no longer matches."""
     try:
         with open(path, "rb") as f:
             f.seek(0, os.SEEK_END)
@@ -124,8 +130,11 @@ def _read_last_record(path, tail_bytes=4096):
     except OSError:
         return None
     for line in reversed([l for l in tail.split(b"\n") if l]):
+        payload, status = fsio.split_checksum(line)
+        if status == "corrupt":
+            continue
         try:
-            return json.loads(line)
+            return json.loads(payload)
         except ValueError:
             continue
     return None
@@ -157,13 +166,97 @@ class SurveyJournal:
         self.journal_path = os.path.join(self.directory, "journal.jsonl")
         self.peaks_path = os.path.join(self.directory, "peaks.jsonl")
         self._peak_rows = None  # lazily loaded peak-store line count
+        self._recovered = False
+
+    # -- crash recovery -----------------------------------------------------
+
+    def _truncate(self, path, length, reason, dropped):
+        fd = os.open(path, os.O_WRONLY)
+        try:
+            os.ftruncate(fd, length)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        log.warning("%s: truncated to %d bytes (%s, %d record(s) "
+                    "dropped)", path, length, reason, dropped)
+        from .incidents import emit
+
+        emit("storage_recovered", action=reason,
+             path=os.path.basename(path), records=int(dropped))
+
+    def _recover_file(self, path):
+        """Truncate a torn/corrupt TAIL back to the last good record;
+        incident-record (without truncating) corrupt records in the
+        middle. Returns the surviving parsed records."""
+        entries, size = fsio.scan_jsonl(path)
+        good, good_end, tail_bad, mid_bad = [], 0, 0, 0
+        for obj, status, end in entries:
+            if obj is not None and status in ("ok", "legacy"):
+                good.append(obj)
+                good_end = end
+                mid_bad += tail_bad
+                tail_bad = 0
+            else:
+                tail_bad += 1
+        if tail_bad:
+            self._truncate(path, good_end, "truncated_torn_tail",
+                           tail_bad)
+        if mid_bad:
+            # Mid-file damage cannot be truncated away without losing
+            # good records after it; readers drop the lines and — for
+            # chunk records — the resume loader re-dispatches them.
+            log.warning("%s: %d corrupt/garbage record(s) mid-file "
+                        "(dropped at read)", path, mid_bad)
+            from .incidents import emit
+
+            emit("record_corrupt", path=os.path.basename(path),
+                 records=int(mid_bad))
+        return good
+
+    def recover(self):
+        """One-shot crash recovery before this process first appends
+        (invoked by :meth:`write_header`; idempotent per instance, and
+        a no-op — byte-for-byte — on a healthy journal):
+
+        1. torn/corrupt tails of ``journal.jsonl`` and ``peaks.jsonl``
+           are truncated back to the last good record;
+        2. peak-store rows beyond every chunk record's claimed range
+           (the writer died after the peak append, before the chunk
+           record) are truncated, so the re-dispatched chunk re-appends
+           at the same offsets and data products stay byte-identical.
+        """
+        if self._recovered:
+            return
+        self._recovered = True
+        recs = self._recover_file(self.journal_path)
+        if not os.path.exists(self.peaks_path):
+            return
+        self._recover_file(self.peaks_path)
+        claimed = 0
+        for rec in recs:
+            if rec.get("kind") == "chunk":
+                claimed = max(claimed, int(rec.get("peaks_offset", 0))
+                              + int(rec.get("peaks_count", 0)))
+        entries, _ = fsio.scan_jsonl(self.peaks_path)
+        rows = [(obj, end) for obj, status, end in entries
+                if obj is not None and status in ("ok", "legacy")]
+        self._peak_rows = None
+        if len(rows) <= claimed:
+            return
+        end = rows[claimed - 1][1] if claimed else 0
+        self._truncate(self.peaks_path, end, "truncated_orphan_peaks",
+                       len(rows) - claimed)
 
     # -- writing ------------------------------------------------------------
 
     def write_header(self, survey_id, chunks_total):
         """Record the survey identity. Idempotent for a matching id; a
         journal holding a DIFFERENT survey raises :class:`JournalMismatch`
-        rather than silently mixing two surveys' chunks."""
+        rather than silently mixing two surveys' chunks. As the first
+        write-intent call of every run it also performs crash recovery
+        (:meth:`recover`) so this process never appends after a torn
+        tail."""
+        self.recover()
         hdr = self._header()
         if hdr is not None:
             if hdr.get("survey_id") != survey_id:
@@ -177,7 +270,7 @@ class SurveyJournal:
             "kind": "header", "version": JOURNAL_VERSION,
             "survey_id": survey_id, "chunks_total": int(chunks_total),
             "utc": _utc_iso(),
-        })
+        }, site="journal_append")
 
     def record_chunk(self, chunk_id, files, dms, peaks, wire_digest=None,
                      timings=None, attempts=1, dq=None, extra=None):
@@ -189,7 +282,8 @@ class SurveyJournal:
         fields into the record (e.g. the multihost layer's degraded
         ``scope``/``process`` markers)."""
         offset = self._peak_store_len()
-        _append_lines(self.peaks_path, [_peak_to_row(p) for p in peaks])
+        _append_lines(self.peaks_path, [_peak_to_row(p) for p in peaks],
+                      site="peaks_append")
         self._peak_rows = offset + len(peaks)
         rec = {
             "kind": "chunk", "chunk_id": int(chunk_id),
@@ -202,7 +296,7 @@ class SurveyJournal:
             "dq": dq or {},
         }
         rec.update(extra or {})
-        _append_line(self.journal_path, rec)
+        _append_line(self.journal_path, rec, site="journal_append")
 
     def record_parked(self, chunk_id, reason, files=None):
         """Journal one *parked* chunk: set aside by the circuit breaker
@@ -214,13 +308,14 @@ class SurveyJournal:
             "kind": "parked", "chunk_id": int(chunk_id),
             "utc": _utc_iso(), "reason": str(reason),
             "files": [os.path.basename(f) for f in files or []],
-        })
+        }, site="journal_append")
 
     def record_metrics(self, summary):
         """Append a metrics snapshot (see MetricsRegistry.summary)."""
         _append_line(self.journal_path, {"kind": "metrics",
                                          "utc": _utc_iso(),
-                                         "summary": summary})
+                                         "summary": summary},
+                     site="journal_append")
 
     def record_incident(self, record):
         """Append one structured ``incident`` record (built by
@@ -231,12 +326,16 @@ class SurveyJournal:
         rec = dict(record)
         rec.setdefault("kind", "incident")
         rec.setdefault("utc", _utc_iso())
-        _append_line(self.journal_path, rec)
+        _append_line(self.journal_path, rec, site="journal_append")
 
     def heartbeat(self, process_index, ts=None):
         """Append one liveness beat to THIS process's sidecar
         (``heartbeat_<p>.jsonl``). Sidecars are single-writer by
-        construction; readers (:meth:`read_heartbeats`) scan them all."""
+        construction; readers (:meth:`read_heartbeats`) scan them all.
+        Beats stay checksum-less plain JSON: the tail reader already
+        tolerates torn lines, and a stale beat is self-correcting —
+        callers treat a failed append as an observability degradation
+        (incident + counter), never a fatal error."""
         import time
 
         p = int(process_index)
@@ -245,6 +344,7 @@ class SurveyJournal:
             {"process": p,
              "ts": float(ts if ts is not None else time.time()),
              "utc": _utc_iso()},
+            site="heartbeat_append", checksum=False,
         )
 
     # -- reading ------------------------------------------------------------

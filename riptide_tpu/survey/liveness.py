@@ -383,8 +383,45 @@ class PeerLivenessMonitor:
 
     def beat(self):
         """Append one heartbeat for this process (call at least once
-        per chunk)."""
+        per chunk). Raises ``OSError`` on a failed append — callers on
+        the survey path go through :meth:`beat_retrying` (or the
+        scheduler's own guard) so a sick filesystem degrades the
+        OBSERVABILITY of liveness without killing the process whose
+        liveness it observes."""
         self.journal.heartbeat(self.process_index, ts=self._clock())
+
+    def beat_retrying(self, attempts=3, base_backoff_s=0.05):
+        """One beat with bounded retry: a transient ``OSError`` (NFS
+        blip, momentary ENOSPC) is retried ``attempts`` times with
+        doubling backoff (capped at 1 s per sleep, so a beater can
+        never wedge past its own interval); on give-up an
+        ``obs_write_failed`` incident + ``obs_write_errors`` counter
+        record the degradation and the caller carries on — a peer with
+        a sick disk should look STALE to survivors, not die and make
+        the staleness real. Returns True on a landed beat."""
+        delay = float(base_backoff_s)
+        last_err = None
+        for i in range(max(1, int(attempts))):
+            try:
+                self.beat()
+                return True
+            except OSError as err:
+                last_err = err
+                if i + 1 < attempts:
+                    time.sleep(min(delay, 1.0))
+                    delay *= 2.0
+        log.warning(
+            "heartbeat append for process %d failed %d time(s), giving "
+            "up until the next interval: %s",
+            self.process_index, attempts, last_err,
+        )
+        self.metrics.add("obs_write_errors")
+        from .incidents import emit as emit_incident
+
+        emit_incident("obs_write_failed", op="heartbeat",
+                      process=self.process_index,
+                      attempts=int(attempts), error=str(last_err))
+        return False
 
     def start_beating(self, interval_s=None):
         """Heartbeat from a background daemon thread every
@@ -396,8 +433,11 @@ class PeerLivenessMonitor:
         the original writer still holds it (two writers on one
         journal). A background beater decouples liveness from progress
         — only a process that is actually dead, or wedged so hard the
-        interpreter makes no progress, stops beating. Idempotent; call
-        :meth:`stop_beating` (or exit the process) to stop."""
+        interpreter makes no progress, stops beating. Beats run through
+        :meth:`beat_retrying`: an I/O failure is retried with bounded
+        backoff and incident-recorded on give-up instead of dying
+        silently in the thread. Idempotent; call :meth:`stop_beating`
+        (or exit the process) to stop."""
         if self._beater_stop is not None:
             return
         stop = threading.Event()
@@ -406,12 +446,9 @@ class PeerLivenessMonitor:
 
         def beater():
             while not stop.wait(interval):
-                try:
-                    self.beat()
-                except OSError as err:  # pragma: no cover - disk loss
-                    log.warning("heartbeat append failed: %s", err)
+                self.beat_retrying()
 
-        self.beat()
+        self.beat_retrying()
         threading.Thread(target=beater, daemon=True,
                          name=f"heartbeat-{self.process_index}").start()
         self._beater_stop = stop

@@ -52,7 +52,7 @@ from ..obs import prom
 from ..obs.chrome import export_run_trace
 from ..obs.schema import chunk_timing
 from ..obs.trace import span
-from ..utils import envflags
+from ..utils import envflags, fsio
 from . import incidents
 from .faults import FaultAbort, FaultPlan
 from .liveness import is_timeout_error
@@ -341,6 +341,7 @@ class SurveyScheduler:
         # equal against its own ledger row).
         self._in_flight = None
         self._run_timings = []
+        self._replayed_timings = []
         self._running = False
 
     # -- staging ------------------------------------------------------------
@@ -474,6 +475,28 @@ class SurveyScheduler:
             self.journal.record_parked(chunk_id, reason,
                                        files=self.chunks[chunk_id])
 
+    # -- heartbeats ---------------------------------------------------------
+
+    def _heartbeat_safe(self):
+        """One per-chunk liveness beat (the monitor's sidecar when
+        multi-host, this process's own otherwise: the /healthz probe
+        and rtop read beat age as THE liveness signal of a run they
+        cannot otherwise observe). Heartbeats are observability, so a
+        failed append can never be fatal: it degrades to an
+        ``obs_write_failed`` incident + ``obs_write_errors`` counter
+        and the survey carries on (a wedged sidecar should make this
+        process LOOK stale, not actually kill it)."""
+        try:
+            if self.monitor is not None:
+                self.monitor.beat()
+            elif self.journal is not None:
+                self.journal.heartbeat(0)
+        except OSError as err:
+            log.warning("heartbeat append failed: %s", err)
+            self.metrics.add("obs_write_errors")
+            incidents.emit("obs_write_failed", op="heartbeat",
+                           error=str(err))
+
     # -- live status --------------------------------------------------------
 
     def status(self):
@@ -543,6 +566,10 @@ class SurveyScheduler:
         if self.journal is not None:
             prev_sink = incidents.set_sink(self.journal.record_incident)
             sink_set = True
+        # Storage fault directives (torn_write/enospc/fsync_fail/
+        # kill_at/cache_corrupt) fire through the fsio layer; point its
+        # hook at this run's plan for the duration.
+        prev_hook = fsio.set_storage_faults(self.faults.storage_op)
         if envflags.get("RIPTIDE_STATUS"):
             prom.set_status_provider(self.status)
         self._running = True
@@ -551,6 +578,7 @@ class SurveyScheduler:
         finally:
             self._running = False
             self._in_flight = None
+            fsio.set_storage_faults(prev_hook)
             if sink_set:
                 incidents.set_sink(prev_sink)
 
@@ -570,6 +598,10 @@ class SurveyScheduler:
                                     expect)
                         continue
                     done[cid] = peaks
+                    # Retained for the ledger: a fully-replayed run
+                    # still owes its row (see end of _run).
+                    if rec.get("timings"):
+                        self._replayed_timings.append(rec["timings"])
                     # Replayed chunks never re-load their files: restore
                     # their DQ provenance from the journal so data
                     # products stay byte-identical to an uninterrupted
@@ -601,14 +633,7 @@ class SurveyScheduler:
                         self._stage, loaders, self.chunks[pending[k + 1]],
                         pending[k + 1],
                     )
-                if self.monitor is not None:
-                    self.monitor.beat()
-                elif self.journal is not None:
-                    # Single-process journaled runs heartbeat too: the
-                    # /healthz probe and rtop read beat age as THE
-                    # liveness signal of a run they cannot otherwise
-                    # observe.
-                    self.journal.heartbeat(0)
+                self._heartbeat_safe()
                 if self.breaker is not None and not self.breaker.allow():
                     self._park(cid, f"circuit {self.breaker.state}")
                     continue
@@ -662,15 +687,31 @@ class SurveyScheduler:
             # trace.json.1 instead of overwriting it).
             export_run_trace(self.journal.directory)
         prom.maybe_write_textfile(self.metrics)
-        if self._run_timings:
-            # One perf-ledger row per run (no-op unless RIPTIDE_LEDGER
-            # is set), derived from the journaled chunk timings by the
-            # same reduction rreport applies to the journal.
-            from ..obs import ledger
-            from ..obs.report import run_decomposition_from_chunks
+        # One perf-ledger row per COMPLETED run (no-op unless
+        # RIPTIDE_LEDGER is set), derived from the journaled chunk
+        # timings by the same reduction rreport applies to the journal.
+        # A resume that replayed EVERY chunk did fresh work only if the
+        # prior attempt died between its final journal write and its
+        # ledger append — in that case (no valid row for this survey in
+        # the ledger yet) the row is derived from the replayed timing
+        # blocks, so "a ledger row per completed run" holds across any
+        # kill point without double-counting ordinary replays.
+        from ..obs import ledger
+        from ..obs.report import run_decomposition_from_chunks
+
+        timings = self._run_timings
+        if not timings and self._replayed_timings:
+            path = ledger.ledger_path()
+            if path and not any(
+                r.get("kind") == "survey"
+                and r.get("survey_id") == self.survey_id
+                for r in ledger.read_rows(path)
+            ):
+                timings = self._replayed_timings
+        if timings:
 
             run_dec, nchunks, bound_counts = \
-                run_decomposition_from_chunks(self._run_timings)
+                run_decomposition_from_chunks(timings)
             ledger.maybe_append(
                 "survey", run_dec, nchunks=nchunks,
                 bound_counts=bound_counts,
@@ -679,6 +720,7 @@ class SurveyScheduler:
                     "chunks_total": len(self.chunks),
                     "chunks_parked":
                         int(self.metrics.counter("chunks_parked")),
+                    "chunks_replayed": len(self._replayed_timings),
                     "elapsed_s": round(time.perf_counter() - t_run0, 3),
                 },
             )

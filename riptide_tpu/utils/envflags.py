@@ -118,10 +118,41 @@ _ALL = [
     EnvFlag(
         "RIPTIDE_FAULT_INJECT", "str", None,
         "Fault-injection spec for the survey scheduler / batch "
-        "searcher, e.g. `stall:0:0.1,raise:2x2,oom:0` (see "
+        "searcher, e.g. `stall:0:0.1,raise:2x2,oom:0` — including the "
+        "storage fault kinds (`kill_at`/`torn_write`/`enospc`/"
+        "`fsync_fail`/`cache_corrupt` targeting a persistence site, "
+        "e.g. `kill_at:journal_append:3`; see "
         "riptide_tpu/survey/faults.py for the grammar). CLI "
         "`--fault-inject` takes precedence.",
         since="PR 1 (0.4.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_CHAOS_DIR", "str", None,
+        "Working directory of the storage-chaos campaign "
+        "(`make chaos` / tools/rchaos.py); default: a fixed per-system "
+        "tempdir. Kept on failure for post-mortems.",
+        since="PR 11 (0.11.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_CHAOS_SEED", "int", 1234,
+        "Seed of the chaos campaign's generated schedule sweep: the "
+        "same seed reproduces the same kill-point/degradation "
+        "combinations (tools/rchaos.py --seed overrides).",
+        since="PR 11 (0.11.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_CHAOS_SWEEP", "int", 0,
+        "How many seeded schedules the chaos campaign appends to the "
+        "fixed builtin set (0 = builtin only, the `make chaos` "
+        "default; the slow test tier and tools/rchaos.py --sweep run "
+        "more).",
+        since="PR 11 (0.11.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_CHAOS_KEEP", "bool", False,
+        "Keep the chaos campaign's working directory after a PASSING "
+        "run too (failures always keep it).",
+        since="PR 11 (0.11.0)",
     ),
     EnvFlag(
         "RIPTIDE_NATIVE_SANITIZE", "bool", False,

@@ -34,11 +34,40 @@ import time
 
 import jax
 
-from . import envflags
+from . import envflags, fsio
 
 log = logging.getLogger("riptide_tpu.exec_cache")
 
 __all__ = ["cached_jit", "load_or_compile_exec", "cache_root"]
+
+# Integrity framing of on-disk entries: MAGIC + 8-hex CRC32 of the
+# pickled body + newline + body. A flipped bit anywhere in the body
+# fails the CRC at load, which is the difference between "recompile"
+# and "deserialize attacker-grade garbage into the runtime". Entries
+# without the magic are legacy (pre-framing) and load as before.
+_ENTRY_MAGIC = b"RTEXEC1\n"
+
+
+def _evict_corrupt(path, name, reason):
+    """A cache entry failed its integrity/load check: incident-record
+    it (naming the evicted path), remove it, and let the caller
+    recompile — corruption must never crash or silently poison a run."""
+    log.warning("exec cache entry for %s is corrupt (%s); evicting %s "
+                "and recompiling", name, reason, path)
+    try:
+        os.remove(path)
+    except OSError as err:
+        log.warning("could not evict corrupt cache entry %s: %s",
+                    path, err)
+    try:
+        from ..survey.incidents import emit
+        from ..survey.metrics import get_metrics
+
+        get_metrics().add("cache_evictions")
+        emit("cache_corrupt", path=path, name=str(name),
+             reason=str(reason))
+    except Exception as err:  # pragma: no cover - advisory path
+        log.warning("cache_corrupt incident emission failed: %s", err)
 
 
 def _dir_trusted(path):
@@ -271,28 +300,61 @@ def load_or_compile_exec(path, jitted, args, kw=None, name="program",
     if os.path.exists(path):
         try:
             with open(path, "rb") as f:
-                payload, in_tree, out_tree = pickle.load(f)
-            info["action"] = "loaded"
-            loaded = se.deserialize_and_load(payload, in_tree, out_tree)
-            _lru_note(path, inserted=False)
-            return loaded
-        except Exception as err:
-            log.warning("exec cache load failed for %s (%s); recompiling",
+                raw = f.read()
+        except OSError as err:
+            raw = None
+            log.warning("exec cache read failed for %s (%s); recompiling",
                         name, err)
+        if raw is not None:
+            body, why = _check_entry(raw)
+            if body is None:
+                # Detected corruption (CRC mismatch / torn frame):
+                # incident, evict, fall through to a clean rebuild.
+                _evict_corrupt(path, name, why)
+            else:
+                try:
+                    payload, in_tree, out_tree = pickle.loads(body)
+                    info["action"] = "loaded"
+                    loaded = se.deserialize_and_load(payload, in_tree,
+                                                     out_tree)
+                    _lru_note(path, inserted=False)
+                    return loaded
+                except Exception as err:
+                    # Undetectable-by-CRC badness (legacy entry rot, a
+                    # jax version change mid-entry): same treatment —
+                    # never crash, never keep the bad entry around.
+                    _evict_corrupt(path, name, f"load failed: {err}")
     info["action"] = "compiled"
     compiled = jitted.lower(*args, **(kw or {})).compile()
     try:
         d = os.path.dirname(path)
         os.makedirs(d, mode=0o700, exist_ok=True)
         payload = se.serialize(compiled)
-        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
-        with os.fdopen(fd, "wb") as f:
-            pickle.dump(payload, f)
-        os.replace(tmp, path)
+        body = pickle.dumps(payload)
+        fsio.atomic_write_bytes(
+            path, _ENTRY_MAGIC + fsio.crc32_hex(body).encode() + b"\n" + body,
+            site="exec_cache_store",
+        )
         _lru_note(path, inserted=True)
     except Exception as err:
         log.warning("exec cache store failed for %s (%s)", name, err)
     return compiled
+
+
+def _check_entry(raw):
+    """``(body, reason)`` integrity check of one on-disk entry: framed
+    entries verify their CRC32 (mismatch -> ``(None, reason)``); legacy
+    unframed entries pass through for the pickle layer to judge."""
+    if not raw.startswith(_ENTRY_MAGIC):
+        return raw, "legacy"
+    head = raw[len(_ENTRY_MAGIC):]
+    if len(head) < 9 or head[8:9] != b"\n":
+        return None, "torn integrity header"
+    want, body = head[:8].decode("ascii", "replace"), head[9:]
+    got = fsio.crc32_hex(body)
+    if got != want:
+        return None, f"CRC mismatch (stored {want}, computed {got})"
+    return body, "ok"
 
 
 def _on_tpu():

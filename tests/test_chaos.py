@@ -1,0 +1,646 @@
+"""
+Storage crash-safety + chaos campaign tests (PR 11).
+
+Covers the fsio layer (checksummed line appends, torn-tail healing,
+atomic write-rename, storage fault hooks), the journal's crash
+recovery (torn/corrupt tail truncation, orphaned-peak reconciliation,
+checksum-less legacy journals), the observability-writes-are-never-
+fatal invariant (heartbeat/ledger/prom/trace degradations complete the
+survey with incidents), the heartbeat beater's bounded retry, exec-
+cache corruption recovery (detect -> incident -> evict -> rebuild),
+the report readers' lenient-line tolerance, and — end to end — one
+subprocess chaos schedule from :mod:`riptide_tpu.survey.chaos`
+(kill mid-journal-append, resume, byte-identical peaks.csv). The full
+builtin campaign plus a seeded sweep runs under ``-m slow`` (and as
+``make chaos``).
+"""
+import errno
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from riptide_tpu.obs import ledger, prom
+from riptide_tpu.obs import report as rep
+from riptide_tpu.survey import chaos, incidents
+from riptide_tpu.survey.faults import FaultPlan
+from riptide_tpu.survey.journal import SurveyJournal
+from riptide_tpu.survey.metrics import get_metrics
+from riptide_tpu.survey.scheduler import RetryPolicy, SurveyScheduler
+from riptide_tpu.peak_detection import Peak
+from riptide_tpu.utils import fsio
+
+from synth import generate_data_presto
+
+TOBS = 12.0
+TSAMP = 1e-3
+PERIOD = 0.5
+
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_globals():
+    """The incident sink, status provider, retained incident and fsio
+    fault hook are process-wide; clear them on BOTH sides of every test
+    (earlier suite files run real schedulers which leave providers
+    registered by design)."""
+    def _clear():
+        incidents.set_sink(None)
+        prom.set_status_provider(None)
+        incidents.clear_last()
+        fsio.set_storage_faults(None)
+
+    _clear()
+    yield
+    _clear()
+
+
+def _peak(period=0.5, snr=10.0, dm=0.0):
+    return Peak(period=period, freq=1.0 / period, width=3, ducy=0.05,
+                iw=1, ip=7, snr=snr, dm=dm)
+
+
+def _capture_incidents():
+    caught = []
+    incidents.set_sink(caught.append)
+    return caught
+
+
+def _searcher():
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+
+    return BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                         SEARCH_CONF, fmt="presto", io_threads=1)
+
+
+def _two_trials(tmp_path):
+    return [
+        generate_data_presto(str(tmp_path), f"c_DM{dm:.2f}", tobs=TOBS,
+                             tsamp=TSAMP, period=PERIOD, dm=dm,
+                             amplitude=30.0)
+        for dm in (0.0, 5.0)
+    ]
+
+
+# ------------------------------------------------------------------- fsio
+
+def test_checksum_roundtrip_and_statuses():
+    payload = b'{"kind":"chunk","chunk_id":3}'
+    line = fsio.encode_record_line(payload)
+    assert line.endswith(b"\n") and b" #" in line
+    got, status = fsio.split_checksum(line.rstrip(b"\n"))
+    assert status == "ok" and got == payload
+    # Legacy line: no suffix.
+    got, status = fsio.split_checksum(payload)
+    assert status == "legacy" and got == payload
+    # Corrupt: payload changed after the suffix was computed.
+    bad = bytearray(line.rstrip(b"\n"))
+    bad[5] ^= 0x01
+    _, status = fsio.split_checksum(bytes(bad))
+    assert status == "corrupt"
+
+
+def test_scan_jsonl_classifies_lines(tmp_path):
+    path = str(tmp_path / "f.jsonl")
+    fsio.append_jsonl(path, [{"a": 1}], checksum=True)
+    fsio.append_jsonl(path, [{"b": 2}], checksum=False)  # legacy
+    with open(path, "ab") as f:
+        f.write(b"not json at all\n")
+        f.write(b'{"kind":"chunk","torn')  # no newline
+    entries, size = fsio.scan_jsonl(path)
+    assert [s for _, s, _ in entries] == ["ok", "legacy", "garbage",
+                                         "torn"]
+    assert entries[0][0] == {"a": 1} and entries[1][0] == {"b": 2}
+    assert entries[-1][2] == size
+
+
+def test_append_heals_torn_tail_with_incident(tmp_path):
+    path = str(tmp_path / "led.jsonl")
+    with open(path, "wb") as f:
+        f.write(b'{"kind":"survey","v":1}\n{"kind":"su')
+    caught = _capture_incidents()
+    fsio.append_jsonl(path, [{"kind": "survey", "n": 2}],
+                      site="ledger_append", checksum=False)
+    rows = rep.read_ledger(path)
+    assert [r.get("n") for r in rows] == [None, 2]
+    assert [c["incident"] for c in caught] == ["storage_recovered"]
+    assert caught[0]["detail"]["action"] == "healed_torn_tail"
+
+
+def test_atomic_write_places_whole_file(tmp_path):
+    path = str(tmp_path / "page.prom")
+    fsio.atomic_write_text(path, "riptide_x_total 1\n",
+                           site="prom_textfile")
+    assert open(path).read() == "riptide_x_total 1\n"
+    # No stray tmp files after a clean write.
+    assert os.listdir(tmp_path) == ["page.prom"]
+
+
+# ------------------------------------------------------- storage faults
+
+def test_fault_plan_parses_storage_kinds():
+    plan = FaultPlan.parse(
+        "kill_at:journal_append:3,enospc:trace_export,"
+        "fsync_fail:heartbeat_appendx2,torn_write:ledger_append,"
+        "cache_corrupt:exec_cache_store:1,raise:2x2")
+    sites = [d.get("site") for d in plan._directives]
+    assert sites[:5] == ["journal_append", "trace_export",
+                         "heartbeat_append", "ledger_append",
+                         "exec_cache_store"]
+    assert plan._directives[0]["nth"] == 3
+    # xN on a site whose NAME contains an 'x' must not parse as repeat.
+    assert plan._directives[1]["remaining"] == 1
+    assert plan._directives[2]["remaining"] == 2
+    assert plan._directives[5] == {"kind": "raise", "chunk": 2,
+                                   "arg": None, "remaining": 2}
+    with pytest.raises(ValueError):
+        FaultPlan.parse("enospc:not_a_site")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("kill_at:journal_append:0")
+
+
+def test_enospc_fires_on_nth_write(tmp_path):
+    plan = FaultPlan.parse("enospc:journal_append:2")
+    fsio.set_storage_faults(plan.storage_op)
+    path = str(tmp_path / "j.jsonl")
+    fsio.append_jsonl(path, [{"n": 1}], site="journal_append")
+    with pytest.raises(OSError) as err:
+        fsio.append_jsonl(path, [{"n": 2}], site="journal_append")
+    assert err.value.errno == errno.ENOSPC
+    # Consumed: the third append goes through.
+    fsio.append_jsonl(path, [{"n": 3}], site="journal_append")
+    assert [r["n"] for r in rep._read_jsonl(path)] == [1, 3]
+
+
+def test_fsync_fail_lands_bytes_but_raises(tmp_path):
+    plan = FaultPlan.parse("fsync_fail:heartbeat_append")
+    fsio.set_storage_faults(plan.storage_op)
+    path = str(tmp_path / "hb.jsonl")
+    with pytest.raises(OSError):
+        fsio.append_jsonl(path, [{"ts": 1.0}], site="heartbeat_append")
+
+
+def test_kill_at_tears_the_record(tmp_path):
+    class Died(Exception):
+        pass
+
+    def fake_exit(code):
+        raise Died(code)
+
+    plan = FaultPlan.parse("kill_at:journal_append:2", exit=fake_exit)
+    fsio.set_storage_faults(plan.storage_op)
+    path = str(tmp_path / "j.jsonl")
+    fsio.append_jsonl(path, [{"kind": "header"}], site="journal_append",
+                      checksum=True)
+    with pytest.raises(Died) as err:
+        fsio.append_jsonl(path, [{"kind": "chunk", "chunk_id": 0}],
+                          site="journal_append", checksum=True)
+    assert err.value.args == (fsio.KILL_EXIT,)
+    entries, _ = fsio.scan_jsonl(path)
+    assert [s for _, s, _ in entries] == ["ok", "torn"]
+
+
+def test_torn_write_raises_eio_without_killing(tmp_path):
+    plan = FaultPlan.parse("torn_write:ledger_append")
+    fsio.set_storage_faults(plan.storage_op)
+    path = str(tmp_path / "led.jsonl")
+    with pytest.raises(OSError) as err:
+        fsio.append_jsonl(path, [{"kind": "survey", "v": 1}],
+                          site="ledger_append", checksum=False)
+    assert err.value.errno == errno.EIO
+    entries, _ = fsio.scan_jsonl(path)
+    assert [s for _, s, _ in entries] == ["torn"]  # the partial prefix
+
+
+# --------------------------------------------------- journal recovery
+
+def test_journal_lines_are_checksummed_heartbeats_plain(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("abc", 1)
+    j.record_chunk(0, ["a.inf"], [0.0], [_peak()])
+    j.heartbeat(0, ts=5.0)
+    for line in open(j.journal_path, "rb").read().splitlines():
+        assert fsio.split_checksum(line)[1] == "ok"
+    for line in open(j.peaks_path, "rb").read().splitlines():
+        assert fsio.split_checksum(line)[1] == "ok"
+    # Heartbeat sidecars stay raw-parseable plain JSON.
+    hb = open(os.path.join(j.directory, "heartbeat_0000.jsonl"),
+              "rb").read().splitlines()
+    assert json.loads(hb[0])["ts"] == 5.0
+
+
+def test_recover_truncates_torn_tail_and_orphans(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("t", 2)
+    j.record_chunk(0, ["a.inf"], [0.0], [_peak()])
+    # Chunk 1 died between its peak append and its chunk record: one
+    # orphaned peak row, plus a torn chunk-record fragment.
+    fsio.append_jsonl(j.peaks_path, [[1.0, 1.0, 3, 0.05, 1, 7, 8.0, 5.0]],
+                      checksum=True)
+    with open(j.journal_path, "ab") as f:
+        f.write(b'{"kind":"chunk","chunk_id":1,"pe')
+    caught = _capture_incidents()
+    j2 = SurveyJournal(tmp_path / "j")
+    j2.write_header("t", 2)
+    kinds = [c["incident"] for c in caught]
+    assert kinds == ["storage_recovered", "storage_recovered"]
+    actions = {c["detail"]["action"] for c in caught}
+    assert actions == {"truncated_torn_tail", "truncated_orphan_peaks"}
+    # Chunk 0 intact; chunk 1 re-dispatched; the peak store holds
+    # exactly the claimed rows again.
+    assert sorted(j2.completed_chunks()) == [0]
+    entries, _ = fsio.scan_jsonl(j2.peaks_path)
+    assert len(entries) == 1 and entries[0][1] == "ok"
+    # Recovery appended nothing and is idempotent: a third open is a
+    # byte-for-byte no-op.
+    b0 = open(j2.journal_path, "rb").read()
+    j3 = SurveyJournal(tmp_path / "j")
+    j3.recover()
+    assert open(j3.journal_path, "rb").read() == b0
+
+
+def test_recover_drops_corrupt_midfile_record_without_truncating(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("c", 2)
+    j.record_chunk(0, ["a.inf"], [0.0], [_peak()])
+    j.record_metrics({"chunks_done": 1})
+    lines = open(j.journal_path, "rb").read().splitlines(keepends=True)
+    chunk_line = bytearray(lines[1])
+    chunk_line[12] ^= 0x01  # flip a payload byte; suffix now mismatches
+    with open(j.journal_path, "wb") as f:
+        f.write(lines[0] + bytes(chunk_line) + lines[2])
+    caught = _capture_incidents()
+    j2 = SurveyJournal(tmp_path / "j")
+    j2.write_header("c", 2)
+    assert any(c["incident"] == "record_corrupt" for c in caught)
+    # The corrupt chunk record is dropped (re-dispatch), its orphaned
+    # peak rows truncated, and the VALID metrics record after it kept.
+    assert j2.completed_chunks() == {}
+    assert j2.last_metrics() == {"chunks_done": 1}
+
+
+def test_legacy_checksumless_journal_resumes_unchanged(tmp_path):
+    jdir = tmp_path / "old"
+    os.makedirs(jdir)
+    peaks = [_peak(), _peak(period=1.0, snr=8.0, dm=10.0)]
+    rows = [[float(getattr(p, f)) if f not in ("width", "iw", "ip")
+             else int(getattr(p, f))
+             for f in ("period", "freq", "width", "ducy", "iw", "ip",
+                       "snr", "dm")] for p in peaks]
+    with open(jdir / "journal.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "header", "version": 1,
+                            "survey_id": "old", "chunks_total": 1}) + "\n")
+        f.write(json.dumps({"kind": "chunk", "chunk_id": 0,
+                            "files": ["a.inf"], "dms": [0.0],
+                            "wire_digest": None, "peaks_offset": 0,
+                            "peaks_count": 2}) + "\n")
+    with open(jdir / "peaks.jsonl", "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    before = open(jdir / "journal.jsonl", "rb").read()
+    j = SurveyJournal(jdir)
+    j.write_header("old", 1)  # recovery + idempotent header
+    done = j.completed_chunks()
+    assert done[0][1] == peaks
+    # A healthy legacy journal is not rewritten or upgraded in place.
+    assert open(jdir / "journal.jsonl", "rb").read() == before
+    # And the report/rtop surface renders it like any other journal.
+    doc = rep.read_journal(str(jdir))
+    assert doc["header"]["survey_id"] == "old"
+    assert sorted(doc["chunks"]) == [0]
+    # New writers may append to it; mixed files parse fine both ways.
+    j.record_metrics({"chunks_done": 1})
+    assert j.last_metrics() == {"chunks_done": 1}
+    assert rep.read_journal(str(jdir))["metrics"] == {"chunks_done": 1}
+
+
+# ------------------------------------- obs writes are never fatal (e2e)
+
+def test_survey_completes_through_obs_write_faults(tmp_path, monkeypatch):
+    """ENOSPC/EIO on heartbeat, prom-textfile AND ledger writes: the
+    survey completes, each degradation is incident-recorded, and the
+    peak results equal a clean run's."""
+    files = _two_trials(tmp_path)
+    get_metrics().reset()
+    clean = SurveyScheduler(_searcher(), [[f] for f in files]).run()
+
+    promfile = str(tmp_path / "metrics.prom")
+    ledgerfile = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("RIPTIDE_PROM_TEXTFILE", promfile)
+    monkeypatch.setenv("RIPTIDE_LEDGER", ledgerfile)
+    get_metrics().reset()
+    journal = SurveyJournal(tmp_path / "j")
+    faults = FaultPlan.parse("fsync_fail:heartbeat_append,"
+                             "enospc:prom_textfile,"
+                             "torn_write:ledger_append")
+    sched = SurveyScheduler(_searcher(), [[f] for f in files],
+                            journal=journal, faults=faults,
+                            retry=RetryPolicy(max_retries=1,
+                                              sleep=lambda s: None))
+    peaks = sched.run()
+    assert peaks == clean
+    assert sorted(journal.completed_chunks()) == [0, 1]
+    ops = sorted(inc["detail"]["op"] for inc in journal.incidents()
+                 if inc["incident"] == "obs_write_failed")
+    assert ops == ["heartbeat", "ledger", "prom_textfile"]
+    assert get_metrics().counter("obs_write_errors") == 3
+    assert not os.path.exists(promfile)
+    # The torn ledger write left only a dropped partial line.
+    assert rep.read_ledger(ledgerfile) == []
+    # The run's fault hook was uninstalled on exit.
+    assert fsio.set_storage_faults(None) is None
+
+
+def test_full_replay_resume_appends_missing_ledger_row(tmp_path,
+                                                       monkeypatch):
+    """A run killed between its final journal write and its ledger
+    append still owes the row: the full-replay resume derives it from
+    the journaled timings — but only when no valid row exists yet."""
+    files = _two_trials(tmp_path)
+    ledgerfile = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("RIPTIDE_LEDGER", ledgerfile)
+    jdir = tmp_path / "j"
+    get_metrics().reset()
+    SurveyScheduler(_searcher(), [[f] for f in files],
+                    journal=SurveyJournal(jdir)).run()
+    rows = rep.read_ledger(ledgerfile)
+    assert len(rows) == 1
+    # Simulate the kill landing mid-ledger-append: a torn row.
+    with open(ledgerfile, "wb") as f:
+        f.write(b'{"kind":"survey","surv')
+    get_metrics().reset()
+    SurveyScheduler(_searcher(), [[f] for f in files],
+                    journal=SurveyJournal(jdir), resume=True).run()
+    rows = rep.read_ledger(ledgerfile)
+    assert len(rows) == 1 and rows[0]["kind"] == "survey"
+    assert rows[0]["nchunks"] == 2 and rows[0]["chunks_replayed"] == 2
+    # A second full-replay resume sees the valid row and appends none.
+    get_metrics().reset()
+    SurveyScheduler(_searcher(), [[f] for f in files],
+                    journal=SurveyJournal(jdir), resume=True).run()
+    assert len(rep.read_ledger(ledgerfile)) == 1
+
+
+# ------------------------------------------------------- beater retry
+
+class _FlakyJournal:
+    """Stub journal whose heartbeat fails ``fail`` times then lands."""
+
+    def __init__(self, fail):
+        self.fail = fail
+        self.beats = 0
+
+    def heartbeat(self, process_index, ts=None):
+        if self.fail > 0:
+            self.fail -= 1
+            raise OSError(errno.EIO, "wedged sidecar")
+        self.beats += 1
+
+
+def test_beater_retries_transient_oserror_then_lands():
+    from riptide_tpu.survey.liveness import PeerLivenessMonitor
+
+    j = _FlakyJournal(fail=2)
+    mon = PeerLivenessMonitor(j, 0, 1, metrics=get_metrics())
+    caught = _capture_incidents()
+    assert mon.beat_retrying(attempts=3, base_backoff_s=0.001) is True
+    assert j.beats == 1
+    assert caught == []  # recovered: no incident
+
+
+def test_beater_gives_up_with_incident_and_stays_alive():
+    """The wedged-peer contract: a sidecar that keeps failing makes the
+    peer LOOK stale (incident + counter), it does not kill the beater."""
+    from riptide_tpu.survey.liveness import PeerLivenessMonitor
+
+    j = _FlakyJournal(fail=99)
+    get_metrics().reset()
+    mon = PeerLivenessMonitor(j, 3, 4, metrics=get_metrics())
+    caught = _capture_incidents()
+    assert mon.beat_retrying(attempts=3, base_backoff_s=0.001) is False
+    assert [c["incident"] for c in caught] == ["obs_write_failed"]
+    assert caught[0]["detail"]["op"] == "heartbeat"
+    assert caught[0]["detail"]["process"] == 3
+    assert get_metrics().counter("obs_write_errors") == 1
+    # The sidecar recovers -> the next interval's beat lands again.
+    j.fail = 0
+    assert mon.beat_retrying(attempts=3, base_backoff_s=0.001) is True
+
+
+# ------------------------------------------------- exec cache recovery
+
+def test_exec_cache_corruption_detect_evict_rebuild(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from riptide_tpu.utils import exec_cache
+
+    path = str(tmp_path / "entry.pkl")
+    jitted = jax.jit(lambda x: x * 3.0)
+    args = (jnp.arange(4.0),)
+    want = np.arange(4.0) * 3.0
+
+    info = {}
+    exec_cache.load_or_compile_exec(path, jitted, args, name="prog",
+                                    info=info)
+    assert info["action"] == "compiled"
+    assert open(path, "rb").read().startswith(b"RTEXEC1\n")
+    info = {}
+    fn = exec_cache.load_or_compile_exec(path, jitted, args, name="prog",
+                                         info=info)
+    assert info["action"] == "loaded"
+    np.testing.assert_allclose(np.asarray(fn(*args)), want)
+
+    # Flip a byte in the stored body: detect, incident (naming the
+    # evicted path), evict, rebuild — identical results throughout.
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    caught = _capture_incidents()
+    get_metrics().reset()
+    info = {}
+    fn = exec_cache.load_or_compile_exec(path, jitted, args, name="prog",
+                                         info=info)
+    assert info["action"] == "compiled"
+    np.testing.assert_allclose(np.asarray(fn(*args)), want)
+    bad = [c for c in caught if c["incident"] == "cache_corrupt"]
+    assert len(bad) == 1
+    assert bad[0]["detail"]["path"] == path
+    assert "CRC mismatch" in bad[0]["detail"]["reason"]
+    assert get_metrics().counter("cache_evictions") == 1
+    # The rebuilt entry loads cleanly.
+    info = {}
+    exec_cache.load_or_compile_exec(path, jitted, args, name="prog",
+                                    info=info)
+    assert info["action"] == "loaded"
+
+
+def test_exec_cache_legacy_unframed_entry_still_loads(tmp_path):
+    import pickle
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import serialize_executable as se
+
+    from riptide_tpu.utils import exec_cache
+
+    path = str(tmp_path / "entry.pkl")
+    jitted = jax.jit(lambda x: x - 1.0)
+    args = (jnp.arange(4.0),)
+    compiled = jitted.lower(*args).compile()
+    with open(path, "wb") as f:
+        pickle.dump(se.serialize(compiled), f)
+    info = {}
+    fn = exec_cache.load_or_compile_exec(path, jitted, args, info=info)
+    assert info["action"] == "loaded"
+    np.testing.assert_allclose(np.asarray(fn(*args)),
+                               np.arange(4.0) - 1.0)
+
+
+# -------------------------------------------- report reader tolerance
+
+def test_read_ledger_tolerates_suffixed_and_garbage_lines(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    fsio.append_jsonl(path, [{"kind": "survey", "n": 1}], checksum=True)
+    fsio.append_jsonl(path, [{"kind": "survey", "n": 2}], checksum=False)
+    corrupt = bytearray(fsio.encode_record_line(
+        json.dumps({"kind": "survey", "n": 3}).encode()))
+    corrupt[3] ^= 0x01
+    with open(path, "ab") as f:
+        f.write(bytes(corrupt))
+        f.write(b"<<<garbage>>>\n")
+        f.write(b'{"kind":"survey","torn')
+    rows = rep.read_ledger(path)
+    assert [r["n"] for r in rows] == [1, 2]
+
+
+def test_journal_follower_reads_checksummed_records(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("f", 2)
+    j.record_chunk(0, ["a.inf"], [0.0], [_peak()],
+                   timings={"chunk_s": 1.0})
+    follower = rep.JournalFollower(str(tmp_path / "j"))
+    doc = follower.poll()
+    assert doc["header"]["survey_id"] == "f"
+    assert sorted(doc["chunks"]) == [0]
+    # A torn tail does not advance the offset; the completed record
+    # appended after it (healed onto its own line) is picked up.
+    with open(j.journal_path, "ab") as f:
+        f.write(b'{"kind":"chunk","chunk_id":1,"to')
+    assert sorted(follower.poll()["chunks"]) == [0]
+    j.record_metrics({"chunks_done": 1})
+    assert follower.poll()["metrics"] == {"chunks_done": 1}
+
+
+def test_parse_prom_text_tolerates_suffix_and_garbage():
+    page = "# HELP riptide_x_total x\n" \
+           "riptide_x_total 3\n" \
+           "riptide_y_total 4 #%08x\n" \
+           "<<torn garbage line with no value\n" % (
+               __import__("zlib").crc32(b"riptide_y_total 4") & 0xFFFFFFFF)
+    values = rep.parse_prom_text(page)
+    assert values["riptide_x_total"][""] == 3.0
+    assert values["riptide_y_total"][""] == 4.0
+
+
+def test_build_report_survives_torn_trace_json(tmp_path):
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("t", 1)
+    j.record_chunk(0, ["a.inf"], [0.0], [_peak()],
+                   timings={"chunk_s": 1.0})
+    with open(os.path.join(j.directory, "trace.json"), "w") as f:
+        f.write('{"traceEvents": [{"ph": "X", "na')  # torn mid-write
+    report = rep.build_report(j.directory)
+    assert "trace" not in report
+    assert "trace.json" in report["trace_error"]
+    assert report["chunks_done"] == 1
+
+
+# ------------------------------------------------ chaos campaign (e2e)
+
+def _campaign_files(tmp_path):
+    datadir = tmp_path / "data"
+    datadir.mkdir()
+    return [
+        generate_data_presto(str(datadir), f"chaos_DM{dm:.2f}",
+                             tobs=chaos.TOBS, tsamp=chaos.TSAMP,
+                             period=chaos.PERIOD, dm=dm,
+                             amplitude=chaos.AMPLITUDE)
+        for dm in chaos.DMS
+    ]
+
+
+def test_chaos_schedule_kill_journal_append_resumes_byte_identical(
+        tmp_path):
+    """The acceptance path in miniature: control run, then a schedule
+    whose first leg is KILLED mid-journal-append (subprocess, exit
+    fsio.KILL_EXIT) and whose resume leg must end byte-identical with
+    the torn tail truncated, incidents recorded, a ledger row present
+    and no duplicate chunk records."""
+    files = _campaign_files(tmp_path)
+    schedules = [s for s in chaos.builtin_schedules()
+                 if s["name"] in ("control", "kill-journal-append")]
+    summary = chaos.run_campaign(files, str(tmp_path / "w"),
+                                 schedules=schedules)
+    assert summary["schedules"] == 2 and summary["legs"] == 3
+    # The faulted schedule's journal holds the recovery incident.
+    recs = [r for r in rep.read_journal(
+        str(tmp_path / "w" / "kill-journal-append" / "j"))["incidents"]
+        if r["incident"] == "storage_recovered"]
+    assert recs
+
+
+def test_seeded_schedules_are_deterministic():
+    a = chaos.seeded_schedules(7, 5)
+    b = chaos.seeded_schedules(7, 5)
+    assert a == b
+    c = chaos.seeded_schedules(8, 5)
+    assert a != c
+    for s in a:
+        assert s["legs"][0]["expect"] == "kill"
+        assert s["legs"][1].get("resume") is True
+
+
+@pytest.mark.slow
+def test_chaos_full_campaign_with_sweep(tmp_path):
+    """`make chaos` plus a seeded sweep: every builtin schedule and
+    three generated ones end byte-identical to the control run."""
+    files = _campaign_files(tmp_path)
+    schedules = chaos.builtin_schedules() + chaos.seeded_schedules(99, 3)
+    summary = chaos.run_campaign(files, str(tmp_path / "w"),
+                                 schedules=schedules)
+    assert summary["schedules"] == len(schedules)
+
+
+# --------------------------------------------------- rreport/rtop compat
+
+def test_rreport_and_rtop_render_checksummed_journal(tmp_path):
+    """The standalone tools parse a PR-11 (checksummed) journal the
+    same way they parse a legacy one."""
+    tools = os.path.normpath(os.path.join(os.path.dirname(__file__),
+                                          "..", "tools"))
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import rreport
+    import rtop
+
+    j = SurveyJournal(tmp_path / "j")
+    j.write_header("t", 1)
+    j.record_chunk(
+        0, ["a.inf"], [0.0], [_peak()],
+        timings={"chunk_s": 1.0, "wire_s": 0.2, "queue_s": 0.1,
+                 "collect_s": 0.6, "host_s": 0.1, "device_s": 0.5,
+                 "prep_s": 0.3, "wire_MBps": 50.0, "bound": "device"})
+    assert rreport.main([str(tmp_path / "j"), "--quiet"]) == 0
+    frame = rtop.render_frame(rreport.load_report_module(),
+                              str(tmp_path / "j"))
+    assert "chunks 1/1" in frame

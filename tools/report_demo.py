@@ -153,9 +153,14 @@ def main(outdir="/tmp/riptide_report_demo"):
         report = json.load(fobj)
     assert not report["phase_sum_violations"], report["phase_sum_violations"]
     # Re-verify the 5% phase-sum bound from the raw journal, not just
-    # rreport's own bookkeeping.
-    with open(os.path.join(jdir, "journal.jsonl")) as fobj:
-        chunks = [json.loads(l) for l in fobj if '"kind":"chunk"' in l]
+    # rreport's own bookkeeping (journal lines carry a per-record CRC32
+    # suffix since PR 11; the report module's lenient parser strips and
+    # verifies it).
+    rep_mod = rreport.load_report_module()
+    with open(os.path.join(jdir, "journal.jsonl"), "rb") as fobj:
+        records = [rep_mod.parse_record_line(l)
+                   for l in fobj.read().splitlines() if l.strip()]
+    chunks = [r for r in records if r and r.get("kind") == "chunk"]
     assert len(chunks) == 2
     for rec in chunks:
         t = rec["timings"]

@@ -76,9 +76,14 @@ def main(outdir="/tmp/riptide_trace_demo"):
                "prep", "wire", "dispatch", "device"} - names
     assert not missing, f"trace is missing spans: {missing}"
 
-    with open(os.path.join(jdir, "journal.jsonl")) as fobj:
-        chunks = [json.loads(l) for l in fobj
-                  if '"kind":"chunk"' in l]
+    # Journal lines carry a per-record CRC32 suffix (PR 11); the report
+    # module's lenient parser strips AND verifies it.
+    from riptide_tpu.obs.report import parse_record_line
+
+    with open(os.path.join(jdir, "journal.jsonl"), "rb") as fobj:
+        records = [parse_record_line(l)
+                   for l in fobj.read().splitlines() if l.strip()]
+    chunks = [r for r in records if r and r.get("kind") == "chunk"]
     for rec in chunks:
         t = rec["timings"]
         serial = t["wire_s"] + t["queue_s"] + t["collect_s"] + t["host_s"]
