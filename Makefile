@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: install check check-full lint native-asan sanitize tests \
+.PHONY: install check check-full prove lint native-asan sanitize tests \
 	tests-cov native bench trace-demo report-demo chaos clean
 
 install:
@@ -17,12 +17,23 @@ install:
 check:
 	$(PYTHON) tools/riplint.py
 
-# The CI form: same analyzers, cache ignored and not written.
+# Semantic static pass: trace the representative search plans' staged
+# programs (jax.make_jaxpr under JAX_PLATFORMS=cpu, no device
+# execution) and verify the pinned program contracts in
+# tools/plan_contracts.json — dispatch counts, peak-HBM model, dtype
+# flow, transfer bytes, donation. Drift = exit 1; re-pin a deliberate
+# change with `python tools/rprove.py --update` (the kernel_digest
+# workflow).
+prove:
+	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py
+
+# The CI form: AST analyzers uncached + the semantic pass.
 check-full:
 	$(PYTHON) tools/riplint.py --no-cache
+	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py
 
-# Everything static (uncached) + the sanitizer-built native tests: the
-# full pre-merge hygiene gate.
+# Everything static (uncached, AST + semantic) + the sanitizer-built
+# native tests: the full pre-merge hygiene gate.
 lint: check-full sanitize
 
 # ASan+UBSan flavor of the native host library. The sanitizer flags are

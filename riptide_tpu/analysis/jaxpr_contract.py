@@ -1,0 +1,566 @@
+"""
+jaxpr-level program contracts: the SEMANTIC static pass (rprove).
+
+riplint's AST analyzers (the rest of this package) enforce source-level
+discipline; the properties that actually decide survey throughput live
+in the *traced computation*: how many XLA programs a plan dispatches,
+how much HBM a DM-batch peaks at, whether a dtype silently widens to
+float64, whether a declared donation is actually honoured. This module
+extracts those properties WITHOUT any device execution — it abstractly
+traces (``jax.make_jaxpr`` / AOT lowering, backend-free under
+``JAX_PLATFORMS=cpu``) the exact programs the engine queues, via the
+queued-stage lowering hooks in :mod:`riptide_tpu.search.engine`
+(``staged_stage_programs`` / ``staged_chunk_program``) — and condenses
+them into one **program contract** per representative search plan:
+
+* **dispatch counts by kind** per stage (fused/pack/kernel/unpack/
+  gather/slice — the fused path must queue one fused program per
+  eligible stage lane bucket and ZERO pack programs);
+* a **peak-HBM-bytes model** ``const + per_dm * D`` from a buffer-
+  liveness walk over the whole-chunk jaxpr at two DM-batch probes
+  (consumed by the batcher's model-seeded DM-batch pick, so OOM
+  bisection becomes a fallback instead of the first resort);
+* a **dtype-flow audit** (no float64/complex128 anywhere in the traced
+  programs; the assembled S/N cube stays float32 — the accumulator
+  dtype the S/N error budget requires);
+* **host<->device transfer** count/bytes per stage (exact from the
+  wire layout);
+* **donation verification** (a program that declares donated inputs
+  must actually alias them to outputs — a dropped donation silently
+  doubles that buffer's footprint).
+
+Contracts are pinned in ``tools/plan_contracts.json`` (the
+``kernel_digest.json`` workflow: ``tools/rprove.py --update`` re-pins,
+any drift is exit 1 in ``make prove`` / ``make check-full``).
+
+Unlike its sibling analyzers this module NEEDS jax, so it is
+deliberately **not** imported by ``riptide_tpu/analysis/__init__.py``
+— the riplint runner's standalone load of the analysis package stays
+jax-free. Import it explicitly (``riptide_tpu.analysis.jaxpr_contract``
+or by file path from ``tools/rprove.py``).
+"""
+import json
+
+import jax
+import numpy as np
+
+__all__ = [
+    "PROBE_D", "HBM_PROBES", "RULES", "HBMModel", "aval_bytes",
+    "peak_live_bytes", "count_f64_eqns", "collect_dtypes",
+    "donation_report", "hbm_model", "build_contract_plan",
+    "extract_contract", "check_contracts", "load_contracts",
+]
+
+# DM-batch size the per-stage programs are traced at (out_bytes divide
+# exactly), and the two probes the linear peak-HBM model is fit from.
+PROBE_D = 2
+HBM_PROBES = (1, 3)
+
+# Dispatch-kind metrics the engine's _count_dispatch maintains.
+_DISPATCH_KINDS = ("fused", "pack", "kernel", "unpack", "gather",
+                   "slice")
+
+# Rule ids of the semantic pass (rprove's SARIF metadata; stable API
+# like the RIPxxx ids).
+RULES = (
+    ("RPV001", "dispatch-drift",
+     "per-stage device-program dispatch counts match the pinned "
+     "contract; fused stages queue zero pack programs"),
+    ("RPV002", "dtype-flow",
+     "no float64/complex128 anywhere in the traced programs and the "
+     "assembled S/N output dtype is pinned"),
+    ("RPV003", "donation",
+     "declared donated inputs are actually aliased to outputs"),
+    ("RPV004", "transfer-drift",
+     "host<->device transfer counts/bytes and closed-over operand "
+     "bytes match the pinned contract"),
+    ("RPV005", "hbm-model-drift",
+     "the buffer-liveness peak-HBM model (const + per_dm * D) matches "
+     "the pinned contract"),
+    ("RPV006", "contract-set",
+     "every contract plan is pinned and every pinned plan still "
+     "exists"),
+)
+
+_F64 = ("float64", "complex128")
+
+
+# ------------------------------------------------------------ jaxpr walks
+
+def _is_var(v):
+    """True for jaxpr Vars (Literals carry ``.val``)."""
+    return not hasattr(v, "val")
+
+
+def aval_bytes(aval):
+    """Buffer bytes of one abstract value (0 for non-array avals such
+    as tokens)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+
+
+def _sub_closed(eqn):
+    """(jaxpr, consts) of every sub-jaxpr a call-like equation carries
+    (pjit/closed_call/cond branches/...): the recursion points of the
+    walks below."""
+    out = []
+    for val in eqn.params.values():
+        items = val if isinstance(val, (tuple, list)) else (val,)
+        for item in items:
+            if hasattr(item, "jaxpr") and hasattr(item, "consts"):
+                out.append((item.jaxpr, tuple(item.consts)))
+            elif hasattr(item, "eqns") and hasattr(item, "invars"):
+                out.append((item, ()))
+    return out
+
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub, _ in _sub_closed(eqn):
+            yield from _walk_eqns(sub)
+
+
+def peak_live_bytes(closed):
+    """Peak simultaneously-live buffer bytes of a (closed) jaxpr, from
+    a liveness walk in equation order: a var is live from its defining
+    equation (inputs/consts from entry) to its last use; outputs stay
+    live to the end. Call-like equations contribute their own recursive
+    peak beyond their operand/result bytes. This is a MODEL of the
+    XLA-scheduled footprint — same operation order, no rematerialisation
+    — pinned for drift detection and consumed (with a budget margin) by
+    the batcher's seeded DM-batch pick."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    consts = tuple(getattr(closed, "consts", ()))
+    const_bytes = sum(int(getattr(c, "nbytes", 0)) for c in consts)
+    return _peak_live(jaxpr, const_bytes)
+
+
+def _peak_live(jaxpr, const_bytes):
+    last_use = {}
+    for idx, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if _is_var(v):
+                last_use[id(v)] = idx
+    out_ids = {id(v) for v in jaxpr.outvars if _is_var(v)}
+    live = {}
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        if _is_var(v):
+            live[id(v)] = aval_bytes(v.aval)
+    peak = sum(live.values()) + const_bytes
+    for idx, eqn in enumerate(jaxpr.eqns):
+        inner_extra = 0
+        for sub, consts in _sub_closed(eqn):
+            cb = sum(int(getattr(c, "nbytes", 0)) for c in consts)
+            io = sum(aval_bytes(v.aval) for v in eqn.invars
+                     if _is_var(v))
+            io += sum(aval_bytes(v.aval) for v in eqn.outvars)
+            inner_extra = max(inner_extra,
+                              _peak_live(sub, cb) - io)
+        for v in eqn.outvars:
+            live[id(v)] = aval_bytes(v.aval)
+        peak = max(peak, sum(live.values()) + const_bytes
+                   + max(0, inner_extra))
+        for v in eqn.invars:
+            if _is_var(v) and last_use.get(id(v)) == idx \
+                    and id(v) not in out_ids:
+                live.pop(id(v), None)
+        for v in eqn.outvars:
+            if id(v) not in last_use and id(v) not in out_ids:
+                live.pop(id(v), None)
+    return peak
+
+
+def count_f64_eqns(closed):
+    """How many equations (recursively) produce a float64/complex128
+    output — the dtype-flow audit's hard zero."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    n = 0
+    for eqn in _walk_eqns(jaxpr):
+        if any(str(getattr(v.aval, "dtype", "")) in _F64
+               for v in eqn.outvars):
+            n += 1
+    return n
+
+
+def collect_dtypes(closed):
+    """Sorted dtype names of every var in the (recursive) jaxpr."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    seen = set()
+
+    def scan(jx):
+        for v in list(jx.invars) + list(jx.constvars) + list(jx.outvars):
+            d = getattr(getattr(v, "aval", None), "dtype", None)
+            if d is not None:
+                seen.add(str(d))
+        for eqn in jx.eqns:
+            for v in eqn.outvars:
+                d = getattr(getattr(v, "aval", None), "dtype", None)
+                if d is not None:
+                    seen.add(str(d))
+            for sub, _ in _sub_closed(eqn):
+                scan(sub)
+
+    scan(jaxpr)
+    return sorted(seen)
+
+
+# -------------------------------------------------------------- donation
+
+def donation_report(fn, args, donate_argnums=()):
+    """``{"donated": n, "dropped": m}`` for one program via AOT
+    lowering (no execution): a donated input XLA can actually reuse
+    carries a ``tf.aliasing_output`` attribute in the lowered module;
+    a declared donation with no alias was DROPPED (shape/dtype
+    mismatch, or the buffer outlives the call) and silently doubles
+    that buffer's footprint."""
+    donate = tuple(donate_argnums)
+    if not donate:
+        return {"donated": 0, "dropped": 0}
+    import warnings
+
+    with warnings.catch_warnings():
+        # jax warns about unusable donations; the report IS the signal.
+        warnings.simplefilter("ignore")
+        txt = jax.jit(fn, donate_argnums=donate).lower(*args).as_text()
+    honored = txt.count("tf.aliasing_output")
+    return {"donated": len(donate),
+            "dropped": max(0, len(donate) - honored)}
+
+
+# ------------------------------------------------------------- HBM model
+
+class HBMModel:
+    """Linear peak-HBM model ``bytes(D) = const + per_dm * D`` fit from
+    the whole-chunk liveness walk at two DM-batch probes."""
+
+    def __init__(self, const_bytes, per_dm_bytes):
+        self.const_bytes = int(const_bytes)
+        self.per_dm_bytes = int(per_dm_bytes)
+
+    def predict(self, D):
+        """Modelled peak bytes of a D-trial chunk."""
+        return self.const_bytes + self.per_dm_bytes * int(D)
+
+    def max_batch(self, budget_bytes):
+        """Largest DM-batch the model predicts fits ``budget_bytes``
+        (never below 1: a single trial must always be attempted — the
+        OOM bisection floor owns the truly-impossible case). A
+        D-independent footprint (``per_dm_bytes`` 0) fits at any batch
+        size or at none; a cap is meaningless either way, so the model
+        reports unbounded rather than forcing maximal splitting."""
+        if self.per_dm_bytes <= 0:
+            return 1 << 62
+        return max(1, (int(budget_bytes) - self.const_bytes)
+                   // self.per_dm_bytes)
+
+    def to_dict(self):
+        return {"const_bytes": self.const_bytes,
+                "per_dm_bytes": self.per_dm_bytes}
+
+
+def _warm_staged(plan, path, mode):
+    """One throwaway whole-chunk trace per (plan, path, mode): the
+    FIRST trace's side effects (device_put of the plan's memoized stage
+    operands, kernel table uploads) change what later traces close
+    over, so extraction always runs against the steady state a running
+    survey sees — contracts stay deterministic across fresh and warm
+    processes."""
+    warmed = getattr(plan, "_contract_warmed", None)
+    if warmed is None:
+        warmed = plan._contract_warmed = set()
+    if (path, mode) in warmed:
+        return
+    from ..search import engine
+
+    fn, args = engine.staged_chunk_program(plan, 1, path=path, mode=mode)
+    jax.make_jaxpr(fn)(*args)
+    warmed.add((path, mode))
+
+
+def _fit_hbm_model(plan, path, mode):
+    from ..search import engine
+
+    _warm_staged(plan, path, mode)
+    peaks = []
+    for D in HBM_PROBES:
+        fn, args = engine.staged_chunk_program(plan, D, path=path,
+                                               mode=mode)
+        peaks.append(peak_live_bytes(jax.make_jaxpr(fn)(*args)))
+    d0, d1 = HBM_PROBES
+    per_dm = max(0, (peaks[1] - peaks[0]) // (d1 - d0))
+    const = max(0, peaks[0] - per_dm * d0)
+    return HBMModel(const, per_dm)
+
+
+def hbm_model(plan, path=None, mode=None):
+    """The plan's peak-HBM model, traced once per (path, mode) and
+    cached on the plan (plans are lru-cached, so a survey pays one
+    trace per distinct search configuration)."""
+    from ..search import engine
+
+    path = path or engine._ffa_path()
+    mode = mode or engine._wire_mode(path)
+    cache = getattr(plan, "_hbm_models", None)
+    if cache is None:
+        cache = plan._hbm_models = {}
+    model = cache.get((path, mode))
+    if model is None:
+        model = cache[(path, mode)] = _fit_hbm_model(plan, path, mode)
+    return model
+
+
+# ------------------------------------------------------- contract extract
+
+def build_contract_plan(spec):
+    """The (cached) PeriodogramPlan of one ``CONTRACT_PLANS`` spec."""
+    from ..search.plan import periodogram_plan
+
+    return periodogram_plan(
+        spec["size"], spec["tsamp"], tuple(spec["widths"]),
+        spec["period_min"], spec["period_max"], spec["bins_min"],
+        spec["bins_max"],
+    )
+
+
+def _dispatch_delta(trace):
+    """Run ``trace`` (a make_jaxpr closure: executes the stage fn's
+    host side, queueing nothing) and return (result, nonzero
+    ``dispatch_<kind>`` counter deltas it fired)."""
+    from ..survey.metrics import get_metrics
+
+    m = get_metrics()
+    before = {k: m.counter(f"dispatch_{k}") for k in _DISPATCH_KINDS}
+    out = trace()
+    delta = {k: int(m.counter(f"dispatch_{k}") - before[k])
+             for k in _DISPATCH_KINDS}
+    return out, {k: v for k, v in delta.items() if v}
+
+
+def extract_contract(name, plan, path=None, mode=None, programs=None):
+    """Extract one plan's full program contract (see module doc for the
+    fields). ``programs`` overrides the engine's queued-stage records
+    (:func:`riptide_tpu.search.engine.staged_stage_programs`) — the
+    seeded-regression tests inject doctored program sets through it."""
+    from ..search import engine
+
+    path = path or engine._ffa_path()
+    mode = mode or engine._wire_mode(path)
+    _warm_staged(plan, path, mode)
+    records = programs
+    if records is None:
+        records = engine.staged_stage_programs(plan, PROBE_D, path=path,
+                                               mode=mode)
+
+    wire = engine.wire_transfer_contract(plan, mode)
+    per_wire = wire.pop("per_stage_wire_bytes_per_dm")
+    stages = []
+    dispatch_total = {}
+    donated = dropped = 0
+    dtypes = set()
+    for r in records:
+        closed, dispatch = _dispatch_delta(
+            lambda r=r: jax.make_jaxpr(r["fn"])(*r["args"]))
+        out_bytes = sum(aval_bytes(v.aval)
+                        for v in closed.jaxpr.outvars)
+        operand_bytes = sum(int(getattr(c, "nbytes", 0))
+                            for c in closed.consts)
+        i = r["stage"]
+        rep = donation_report(r["fn"], r["args"], r.get("donate", ()))
+        stages.append({
+            "stage": i,
+            "kind": r["kind"],
+            "dispatch": dispatch,
+            "operand_bytes": int(operand_bytes),
+            "out_bytes_per_dm": int(out_bytes // PROBE_D),
+            "wire_bytes_per_dm": int(per_wire[i]) if i < len(per_wire)
+            else 0,
+            "f64_eqns": count_f64_eqns(closed),
+            "donation": rep,
+        })
+        for k, v in dispatch.items():
+            dispatch_total[k] = dispatch_total.get(k, 0) + v
+        donated += rep["donated"]
+        dropped += rep["dropped"]
+        dtypes.update(collect_dtypes(closed))
+
+    chunk_fn, chunk_args = engine.staged_chunk_program(plan, PROBE_D,
+                                                       path=path,
+                                                       mode=mode)
+    chunk_closed = jax.make_jaxpr(chunk_fn)(*chunk_args)
+    out_dtype = str(chunk_closed.jaxpr.outvars[0].aval.dtype)
+    model = hbm_model(plan, path=path, mode=mode)
+
+    return {
+        "path": path,
+        "wire_mode": mode,
+        "n_stages": len(plan.stages),
+        "stages": stages,
+        "dispatch_total": dict(sorted(dispatch_total.items())),
+        "transfers": wire,
+        "donation": {"donated": int(donated), "dropped": int(dropped)},
+        "dtypes": sorted(dtypes),
+        "out_dtype": out_dtype,
+        "hbm": model.to_dict(),
+    }
+
+
+# --------------------------------------------------------- contract check
+
+def _finding(rel, rule, message):
+    return {"path": rel, "line": 1, "col": 0, "rule": rule,
+            "message": message}
+
+
+def check_contracts(pinned_doc, current, all_names,
+                    contract_rel="tools/plan_contracts.json"):
+    """Compare freshly-extracted contracts against the pinned document.
+
+    ``current`` maps plan name -> contract (the subset this run
+    traced); ``all_names`` is the FULL contract plan-set name list
+    (every tier), so stale pinned entries are detected even when only
+    the fast tier was re-traced. Returns riplint-shaped finding dicts
+    (path/line/col/rule/message) — empty means zero drift. Two checks
+    are ABSOLUTE (fail even if pinned agrees, because pinning them
+    would bless a defect): float64 in a traced program, and a dropped
+    donation."""
+    pinned_plans = (pinned_doc or {}).get("plans", {})
+    findings = []
+
+    for stale in sorted(set(pinned_plans) - set(all_names)):
+        findings.append(_finding(
+            contract_rel, "RPV006",
+            f"plan {stale!r}: pinned contract has no matching entry in "
+            "ops.plan.CONTRACT_PLANS — delete it (rprove --update) or "
+            "restore the plan spec"))
+
+    for name in sorted(current):
+        cur = current[name]
+        # Absolute rules first: these fail on the CURRENT tree alone.
+        for st in cur["stages"]:
+            if st["f64_eqns"]:
+                findings.append(_finding(
+                    contract_rel, "RPV002",
+                    f"plan {name!r} stage {st['stage']}: "
+                    f"{st['f64_eqns']} float64-producing op(s) in the "
+                    "traced program — the dtype-flow audit forbids f64 "
+                    "on device (fix the promotion; --update cannot "
+                    "bless it)"))
+            if st["kind"] == "fused" and st["dispatch"].get("pack"):
+                findings.append(_finding(
+                    contract_rel, "RPV001",
+                    f"plan {name!r} stage {st['stage']}: fused stage "
+                    f"queues {st['dispatch']['pack']} pack program(s) "
+                    "— the fused path's contract is one fused program "
+                    "per lane bucket and ZERO pack programs"))
+        for st in cur["stages"]:
+            if st["donation"]["dropped"]:
+                findings.append(_finding(
+                    contract_rel, "RPV003",
+                    f"plan {name!r} stage {st['stage']}: "
+                    f"{st['donation']['dropped']} donated buffer(s) "
+                    "dropped (declared but not aliased to any output) "
+                    "— the donated HBM is silently double-counted; fix "
+                    "the program shape or drop the donation"))
+
+        pin = pinned_plans.get(name)
+        if pin is None:
+            findings.append(_finding(
+                contract_rel, "RPV006",
+                f"plan {name!r}: no pinned contract — run "
+                "`python tools/rprove.py --update` and commit the "
+                "result"))
+            continue
+
+        # Per-stage drift, most specific message first.
+        pin_stages = {s["stage"]: s for s in pin.get("stages", ())}
+        for st in cur["stages"]:
+            ps = pin_stages.get(st["stage"])
+            if ps is None:
+                findings.append(_finding(
+                    contract_rel, "RPV001",
+                    f"plan {name!r} stage {st['stage']}: not in the "
+                    "pinned contract (stage set changed) — re-pin with "
+                    "--update if intentional"))
+                continue
+            if st["kind"] != ps.get("kind") \
+                    or st["dispatch"] != ps.get("dispatch"):
+                findings.append(_finding(
+                    contract_rel, "RPV001",
+                    f"plan {name!r} stage {st['stage']}: dispatch "
+                    f"drift — pinned {ps.get('kind')}:"
+                    f"{ps.get('dispatch')} != traced {st['kind']}:"
+                    f"{st['dispatch']} (a changed/extra device program "
+                    "per chunk; re-pin with --update only if "
+                    "intentional)"))
+            if st["operand_bytes"] != ps.get("operand_bytes"):
+                findings.append(_finding(
+                    contract_rel, "RPV004",
+                    f"plan {name!r} stage {st['stage']}: closed-over "
+                    f"operand bytes drift {ps.get('operand_bytes')} -> "
+                    f"{st['operand_bytes']} — an unplanned host->device "
+                    "transfer rides along with this stage's program"))
+            if st["wire_bytes_per_dm"] != ps.get("wire_bytes_per_dm"):
+                findings.append(_finding(
+                    contract_rel, "RPV004",
+                    f"plan {name!r} stage {st['stage']}: wire bytes "
+                    f"per DM drift {ps.get('wire_bytes_per_dm')} -> "
+                    f"{st['wire_bytes_per_dm']}"))
+            if st["out_bytes_per_dm"] != ps.get("out_bytes_per_dm"):
+                findings.append(_finding(
+                    contract_rel, "RPV004",
+                    f"plan {name!r} stage {st['stage']}: output bytes "
+                    f"per DM drift {ps.get('out_bytes_per_dm')} -> "
+                    f"{st['out_bytes_per_dm']}"))
+            if st["donation"] != ps.get("donation"):
+                findings.append(_finding(
+                    contract_rel, "RPV003",
+                    f"plan {name!r} stage {st['stage']}: donation "
+                    f"drift — pinned {ps.get('donation')} != traced "
+                    f"{st['donation']}"))
+        for missing in sorted(set(pin_stages)
+                              - {s["stage"] for s in cur["stages"]}):
+            findings.append(_finding(
+                contract_rel, "RPV001",
+                f"plan {name!r} stage {missing}: pinned but no longer "
+                "traced (stage set changed) — re-pin with --update if "
+                "intentional"))
+
+        if cur["transfers"] != pin.get("transfers"):
+            findings.append(_finding(
+                contract_rel, "RPV004",
+                f"plan {name!r}: transfer contract drift — pinned "
+                f"{pin.get('transfers')} != traced "
+                f"{cur['transfers']}"))
+        if cur["out_dtype"] != pin.get("out_dtype"):
+            findings.append(_finding(
+                contract_rel, "RPV002",
+                f"plan {name!r}: assembled S/N dtype drift "
+                f"{pin.get('out_dtype')} -> {cur['out_dtype']} — the "
+                "f32 accumulator contract of the S/N error budget"))
+        if cur["donation"] != pin.get("donation"):
+            findings.append(_finding(
+                contract_rel, "RPV003",
+                f"plan {name!r}: donation contract drift — pinned "
+                f"{pin.get('donation')} != traced {cur['donation']}"))
+        if cur["hbm"] != pin.get("hbm"):
+            findings.append(_finding(
+                contract_rel, "RPV005",
+                f"plan {name!r}: peak-HBM model drift — pinned "
+                f"{pin.get('hbm')} != traced {cur['hbm']} (the "
+                "batcher's seeded DM-batch pick consumes this model; "
+                "re-pin with --update after a deliberate memory-"
+                "footprint change)"))
+    return findings
+
+
+def load_contracts(path):
+    """The pinned contract document, or None when absent."""
+    try:
+        with open(path) as fobj:
+            return json.load(fobj)
+    except OSError:
+        return None

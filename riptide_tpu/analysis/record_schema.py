@@ -66,6 +66,8 @@ WRITER_SPECS = (
     ("riptide_tpu/obs/ledger.py", "make_row", "ledger"),
     ("riptide_tpu/obs/schema.py", "chunk_timing", "timing"),
     ("riptide_tpu/obs/schema.py", "decomposition", "ledger"),
+    # The chunk record's predicted-vs-actual peak-HBM block (PR 12).
+    ("riptide_tpu/obs/schema.py", "hbm_block", "hbm"),
     # Provenance merged in through `extra=` at the call sites.
     ("riptide_tpu/survey/scheduler.py", "SurveyScheduler._run", "ledger"),
     ("riptide_tpu/parallel/multihost.py", "run_search_multihost",

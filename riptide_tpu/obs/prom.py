@@ -62,6 +62,7 @@ _HELP = {
     "breaker_opens": "circuit-breaker transitions to open",
     "peer_losses": "collectives degraded to local-only mode",
     "oom_bisections": "DM-batch halvings after device OOM",
+    "oom_predicted": "proactive DM-batch splits by the peak-HBM model",
     "incidents": "structured incident records emitted",
     "obs_write_errors": "observability writes degraded to incidents",
     "wire_bytes": "bytes shipped over the host->device wire",

@@ -38,7 +38,8 @@ __all__ = [
     "PHASE_SUM_TOL", "SERIAL_PHASES", "JournalFollower", "read_journal",
     "read_heartbeats", "read_ledger", "parse_prom_text",
     "load_trace_summary", "run_decomposition_from_chunks",
-    "phase_attribution", "stragglers", "tunnel_stats", "build_report",
+    "phase_attribution", "stragglers", "tunnel_stats", "hbm_stats",
+    "build_report",
     "render_text", "compare_to_ledger", "latest_platform",
     "drop_own_row", "strip_checksum", "parse_record_line",
 ]
@@ -384,6 +385,38 @@ def tunnel_stats(chunks):
     return out
 
 
+def hbm_stats(chunks):
+    """Predicted-vs-actual peak-HBM calibration over the journaled
+    chunks' ``hbm`` blocks (written while the jaxpr-contract model
+    seeds the DM batch — see obs.schema.hbm_block). ``ratio_median``
+    is actual/predicted: the number that tunes the model (or the
+    budget margin) against real runs. Empty blocks (seeding off, or
+    pre-0.12 journals) contribute nothing."""
+    preds, actuals, ratios = [], [], []
+    budget = None
+    for rec in chunks.values():
+        h = rec.get("hbm") or {}
+        if h.get("predicted_bytes") is not None:
+            preds.append(float(h["predicted_bytes"]))
+        if h.get("actual_bytes") is not None:
+            actuals.append(float(h["actual_bytes"]))
+        if h.get("ratio") is not None:
+            ratios.append(float(h["ratio"]))
+        if h.get("budget_bytes") is not None:
+            budget = int(h["budget_bytes"])
+    out = {"n_modelled": len(preds)}
+    if preds:
+        out["predicted_bytes_max"] = int(max(preds))
+        out["predicted_bytes_mean"] = int(sum(preds) / len(preds))
+    if budget is not None:
+        out["budget_bytes"] = budget
+    if actuals:
+        out["actual_bytes_max"] = int(max(actuals))
+    if ratios:
+        out["ratio_median"] = round(_median(ratios), 4)
+    return out
+
+
 # ------------------------------------------------------------ the report
 
 def build_report(journal_dir, trace_path=None, prom_path=None):
@@ -408,6 +441,7 @@ def build_report(journal_dir, trace_path=None, prom_path=None):
         "phase_sum_violations": violations,
         "stragglers": stragglers(chunks),
         "tunnel": tunnel_stats(chunks),
+        "hbm": hbm_stats(chunks),
         "incidents": j["incidents"],
         "metrics": j["metrics"],
     }
@@ -460,6 +494,21 @@ def render_text(report):
             f"{tun['swing_MBps'][1]}); "
             f"{tun['chunks_below_knee']}/{tun['n_rates']} chunk(s) "
             f"below the {tun['knee_MBps']} MB/s knee")
+    hbm = report.get("hbm") or {}
+    if hbm.get("n_modelled"):
+        add("")
+        line = (f"hbm model: {hbm['n_modelled']} chunk(s) modelled, "
+                f"predicted peak max "
+                f"{hbm['predicted_bytes_max'] / 1e6:.1f} MB")
+        if hbm.get("budget_bytes") is not None:
+            line += f" (budget {hbm['budget_bytes'] / 1e6:.1f} MB)"
+        if hbm.get("actual_bytes_max") is not None:
+            line += (f"; actual peak max "
+                     f"{hbm['actual_bytes_max'] / 1e6:.1f} MB")
+        if hbm.get("ratio_median") is not None:
+            line += (f", actual/predicted median "
+                     f"{hbm['ratio_median']}")
+        add(line)
     if report["stragglers"]:
         add("")
         add("stragglers (> {:.1f}x median chunk_s):".format(

@@ -39,6 +39,7 @@ treat an alias's presence as deprecation notice for the old name).
 __all__ = [
     "TIMING_VERSION", "PHASES", "DECOMPOSITION_KEYS", "CHUNK_TIMING_KEYS",
     "LEGACY_ALIASES", "decomposition", "chunk_timing", "classify_bound",
+    "hbm_block",
 ]
 
 TIMING_VERSION = 1
@@ -112,4 +113,23 @@ def chunk_timing(chunk_s, prep_s=0.0, wire_s=0.0, queue_s=0.0,
     }
     if wire_bytes and wire_s > 0:
         out["wire_MBps"] = round(wire_bytes / 1e6 / wire_s, 3)
+    return out
+
+
+def hbm_block(predicted_bytes, actual_bytes, budget_bytes):
+    """One chunk's journal ``hbm`` block, sibling of the ``timings``/
+    ``dq`` blocks: the jaxpr-contract model's predicted peak device
+    bytes for the chunk's queued programs vs the backend-reported peak,
+    plus the seeding budget. ``actual_bytes`` is absent where the
+    backend exposes no memory stats (the CPU backend) AND on chunks
+    that did not raise the process-lifetime high-water mark — only the
+    mark-setting chunk's ratio is a calibration signal (see
+    BatchSearcher.chunk_hbm_block). rreport's hbm section reduces
+    these so the model is calibratable against real runs."""
+    out = {"predicted_bytes": int(predicted_bytes),
+           "budget_bytes": int(budget_bytes)}
+    if actual_bytes:
+        out["actual_bytes"] = int(actual_bytes)
+        if predicted_bytes > 0:
+            out["ratio"] = round(actual_bytes / predicted_bytes, 4)
     return out

@@ -28,7 +28,50 @@ import numpy as np
 
 from .reference import _merge_mapping
 
-__all__ = ["ffa_plan", "FFAPlan", "batch_plans", "num_levels"]
+__all__ = ["ffa_plan", "FFAPlan", "batch_plans", "num_levels",
+           "CONTRACT_PLANS", "contract_plan_params"]
+
+
+# Representative search-plan parameter sets the semantic static pass
+# pins program contracts for (riptide_tpu/analysis/jaxpr_contract.py,
+# tools/rprove.py, tools/plan_contracts.json). Each spec names a
+# PeriodogramPlan configuration plus the execution path/wire mode the
+# contract describes. `fast`-tier plans are tiny (traced in tier-1 and
+# on every `make prove`); the `slow` tier adds a survey-shaped plan
+# (`rprove --all`, slow test tier). The two tiny plans share one
+# geometry so the gather and fused-kernel formulations of the SAME
+# search are pinned side by side.
+CONTRACT_PLANS = (
+    {"name": "tiny-gather", "tier": "fast", "path": "gather",
+     "wire": "float32", "size": 2048, "tsamp": 0.01, "widths": (1, 2),
+     "period_min": 1.0, "period_max": 2.0, "bins_min": 16,
+     "bins_max": 24},
+    {"name": "tiny-fused", "tier": "fast", "path": "kernel",
+     "wire": "uint6", "size": 2048, "tsamp": 0.01, "widths": (1, 2),
+     "period_min": 1.0, "period_max": 2.0, "bins_min": 16,
+     "bins_max": 24},
+    {"name": "survey-fused", "tier": "slow", "path": "kernel",
+     "wire": "uint6", "size": 16000, "tsamp": 1e-3,
+     "widths": (1, 2, 3), "period_min": 0.3, "period_max": 1.2,
+     "bins_min": 64, "bins_max": 71},
+)
+
+
+def contract_plan_params(names=None, tiers=("fast",)):
+    """Resolve the contract plan set: by explicit ``names`` (unknown
+    names raise KeyError — a stale name list must fail loudly, the
+    HOT_FUNCTIONS discipline), else by tier."""
+    specs = [dict(s) for s in CONTRACT_PLANS]
+    if names:
+        wanted = set(names)
+        unknown = wanted - {s["name"] for s in specs}
+        if unknown:
+            raise KeyError(
+                f"unknown contract plan name(s) {sorted(unknown)}; "
+                f"known: {[s['name'] for s in specs]}"
+            )
+        return [s for s in specs if s["name"] in wanted]
+    return [s for s in specs if s["tier"] in tiers]
 
 
 def num_levels(m):

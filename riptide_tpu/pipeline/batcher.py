@@ -40,6 +40,7 @@ from ..search.engine import (
 from ..survey import incidents
 from ..survey.metrics import get_metrics
 from ..time_series import TimeSeries
+from ..utils import envflags
 
 log = logging.getLogger("riptide_tpu.pipeline.batcher")
 
@@ -301,6 +302,13 @@ class BatchSearcher:
                     prepared, _ = prepare_stage_data_sharded(
                         plan, batch, self.mesh
                     )
+                elif self._seed_batch_limit(plan, batch.shape[0]) \
+                        is not None:
+                    # The HBM model will split this batch at queue time
+                    # (_queue_range): preparing and shipping the
+                    # full-batch wire here would be discarded work —
+                    # the seeded slices prepare their own.
+                    prepared = None
                 else:
                     prepared = prepare_stage_data(plan, batch)
                 items.append((members, batch, conf, plan, prepared))
@@ -328,7 +336,9 @@ class BatchSearcher:
                 for members, batch, conf, plan, prepared in items
             ]
         return [
-            (members, batch, conf, plan, ship_stage_data(plan, prepared))
+            (members, batch, conf, plan,
+             ship_stage_data(plan, prepared) if prepared is not None
+             else None)
             for members, batch, conf, plan, prepared in items
         ]
 
@@ -340,6 +350,76 @@ class BatchSearcher:
 
     def _collect_chunk(self, queued):
         return [p for collect in queued for p in collect()]
+
+    # -- model-seeded DM-batch pick (the jaxpr-contract HBM model) ----------
+
+    def _hbm_model(self, plan):
+        """The plan's traced peak-HBM model
+        (:func:`riptide_tpu.analysis.jaxpr_contract.hbm_model`, cached
+        on the plan), or None when tracing fails — the model is an
+        optimisation and must never be a reason a search cannot run.
+        Failures are cached too (one warning, one trace attempt per
+        plan — not one per chunk work item for the whole survey)."""
+        if getattr(plan, "_hbm_model_failed", False):
+            return None
+        try:
+            from ..analysis.jaxpr_contract import hbm_model
+
+            return hbm_model(plan)
+        except Exception as err:
+            plan._hbm_model_failed = True
+            log.warning("peak-HBM model unavailable for this plan (%s); "
+                        "OOM bisection remains the only throttle", err)
+            return None
+
+    def _seed_batch_limit(self, plan, D):
+        """Largest DM batch the HBM model predicts fits the
+        ``RIPTIDE_HBM_BUDGET`` budget, or None when seeding is off
+        (budget unset/0, mesh-sharded path) / unavailable / D already
+        fits. Seeding turns the old dispatch->OOM->halve cycle into a
+        proactive split: bisection stays as the fallback for a model
+        miss."""
+        budget = envflags.get("RIPTIDE_HBM_BUDGET")
+        if not budget or self.mesh is not None:
+            return None
+        model = self._hbm_model(plan)
+        if model is None:
+            return None
+        limit = max(1, model.max_batch(int(budget)))
+        return limit if limit < D else None
+
+    def chunk_hbm_block(self, items):
+        """Predicted-vs-actual peak device bytes of one chunk's queued
+        programs, as the journal's per-chunk ``hbm`` block — the
+        calibration record the model is tuned against. None while
+        seeding is disabled (no model was built, so there is nothing to
+        calibrate). Predictions sum over the chunk's work items at
+        their seeded (post-split) batch sizes. The backend-reported
+        peak is a process-lifetime HIGH-WATER MARK, so ``actual`` is
+        attributed only to a chunk that RAISED it — later chunks under
+        the mark carry no calibration signal and omit it (a ratio
+        against another chunk's watermark would bias the tuning)."""
+        budget = envflags.get("RIPTIDE_HBM_BUDGET")
+        if not budget or self.mesh is not None:
+            return None
+        predicted = 0
+        for item in items:
+            batch, plan = item[1], item[3]
+            model = self._hbm_model(plan)
+            if model is None:
+                return None
+            D = batch.shape[0]
+            predicted += model.predict(min(D, model.max_batch(int(budget))))
+        from ..obs.schema import hbm_block
+        from ..search.engine import device_peak_bytes
+
+        actual = device_peak_bytes()
+        prev = getattr(self, "_hbm_peak_seen", None)
+        if actual is not None:
+            self._hbm_peak_seen = actual
+            if prev is not None and actual <= prev:
+                actual = None
+        return hbm_block(predicted, actual, int(budget))
 
     def _queue_range(self, conf, members, batch, plan, shipped=None):
         """Enqueue one (search range x chunk) device program; returns a
@@ -367,6 +447,23 @@ class BatchSearcher:
                 return [p for d in range(nreal) for p in peaks_per_trial[d]]
 
             return collect_mesh
+        limit = self._seed_batch_limit(plan, batch.shape[0])
+        if limit is not None:
+            # The HBM model says this batch exceeds the budget: split
+            # PROACTIVELY at the largest predicted-to-fit size instead
+            # of paying a dispatch + OOM + halving cycle. The slices
+            # re-prepare their own wire (the already-shipped buffer is
+            # dropped, exactly like the bisection path), and a real OOM
+            # inside a slice still bisects — the model seeds, the
+            # bisection insures.
+            get_metrics().add("oom_predicted")
+            incidents.emit("oom_predicted", batch=batch.shape[0],
+                           limit=int(limit))
+            log.info("HBM model caps the %d-trial batch at %d trials "
+                     "per dispatch", batch.shape[0], limit)
+            return lambda: self._collect_seeded(
+                plan, batch, dms, tobs, fp_kwargs, nreal, limit
+            )
         try:
             self._maybe_oom(batch.shape[0])
             handle = queue_search_batch(
@@ -397,6 +494,22 @@ class BatchSearcher:
             return [p for d in range(nreal) for p in peaks_per_trial[d]]
 
         return collect
+
+    def _collect_seeded(self, plan, batch, dms, tobs, fp_kwargs, nreal,
+                        limit):
+        """Collector of a model-capped chunk: search the DM batch in
+        ``limit``-sized slices (the largest size the HBM model predicts
+        fits the budget), synchronously like the bisection path. A real
+        OOM inside a slice still bisects — the model seeds, the
+        bisection insures."""
+        dms = np.asarray(dms, dtype=float)
+        D = batch.shape[0]
+        ppt = []
+        for lo in range(0, D, limit):
+            hi = min(lo + limit, D)
+            ppt += self._search_slice(plan, batch, dms, tobs, fp_kwargs,
+                                      lo, hi)
+        return [p for d in range(nreal) for p in ppt[d]]
 
     # -- OOM-aware adaptive bisection ---------------------------------------
 

@@ -45,7 +45,9 @@ from ..ops.snr import snr_batched
 __all__ = ["run_periodogram", "run_periodogram_batch", "run_search_batch",
            "queue_search_batch", "collect_search_batch", "search_snr_dev",
            "cycle_fn", "is_oom_error", "is_timeout_error",
-           "device_fingerprint"]
+           "device_fingerprint", "device_peak_bytes",
+           "staged_stage_programs", "staged_chunk_program",
+           "staged_wire_operands", "wire_transfer_contract"]
 
 
 def device_fingerprint():
@@ -723,6 +725,36 @@ def _run_stage_gather(st, xd_dev, plan):
     )
 
 
+def _run_stage_unpack_gather(st, part, off, plan, meta, i):
+    """Queue one gather-path stage FROM THE SHIPPED WIRE (decode/unpack
+    program, then the gather program): the `_queue_stages` fallback
+    branches, extracted so the rprove lowering hook
+    (:func:`staged_stage_programs`) traces exactly the programs the
+    engine queues — the two can never drift apart."""
+    mode = meta["mode"]
+    if mode in _WIRE_Q:
+        vl = meta["view"]
+        with span("dispatch", kind="unpack", stage=i):
+            xd = _unpack_view_padded(part, meta["scales_dev"], mode, off,
+                                     vl["wrows"][i], int(vl["soffs"][i]),
+                                     vl["r0s"][i], st.n, plan.nout)
+        _count_dispatch("unpack")
+    else:
+        # Gather-path programs are keyed by series length: restore the
+        # plan-wide padded length so all stages share one compiled
+        # program. Also promote a float16 wire back to float32 — the
+        # gather path accumulates in its input dtype.
+        with span("dispatch", kind="unpack", stage=i):
+            xd = jax.lax.slice_in_dim(part, off, off + st.n, axis=-1)
+            xd = jnp.pad(xd.astype(jnp.float32),
+                         [(0, 0), (0, plan.nout - st.n)])
+        _count_dispatch("unpack")
+    with span("dispatch", kind="gather", stage=i):
+        out = _run_stage_gather(st, xd, plan)
+    _count_dispatch("gather")
+    return out
+
+
 def _stage_operands(st):
     """Device operands of a CycleStage, memoized on the stage so repeated
     searches with a cached plan ship only the data, not the tables."""
@@ -926,31 +958,9 @@ def _queue_stages(plan, batch, prepared=None, shipped=None):
         if path == "kernel" and _kernel_eligible(st, plan):
             outs.append((_run_stage_kernel(st, parts[c], off, plan, meta,
                                            i),))
-        elif mode in _WIRE_Q:
-            vl = meta["view"]
-            with span("dispatch", kind="unpack", stage=i):
-                xd = _unpack_view_padded(parts[c], meta["scales_dev"],
-                                         mode, off, vl["wrows"][i],
-                                         int(vl["soffs"][i]), vl["r0s"][i],
-                                         st.n, plan.nout)
-            _count_dispatch("unpack")
-            with span("dispatch", kind="gather", stage=i):
-                outs.append((_run_stage_gather(st, xd, plan),))
-            _count_dispatch("gather")
         else:
-            # Gather-path programs are keyed by series length: restore
-            # the plan-wide padded length so all stages share one
-            # compiled program. Also promote a float16 wire back to
-            # float32 — the gather path accumulates in its input dtype.
-            with span("dispatch", kind="unpack", stage=i):
-                xd = jax.lax.slice_in_dim(parts[c], off, off + st.n,
-                                          axis=-1)
-                xd = jnp.pad(xd.astype(jnp.float32),
-                             [(0, 0), (0, plan.nout - st.n)])
-            _count_dispatch("unpack")
-            with span("dispatch", kind="gather", stage=i):
-                outs.append((_run_stage_gather(st, xd, plan),))
-            _count_dispatch("gather")
+            outs.append((_run_stage_unpack_gather(st, parts[c], off,
+                                                  plan, meta, i),))
     return outs, tuple(layout)
 
 
@@ -1093,6 +1103,166 @@ def warm_stage_kernels(plan, D, parallel=True):
             log.info("bucket L=%d rows=%d P=%d B=%d D=%d: %s in %.1fs",
                      k[0], k[2], k[3], k[9], k[8], c.source, c.warm_seconds)
     return len(calls)
+
+
+# ---------------------------------------------------------------------------
+# Queued-stage lowering hooks: the surface the semantic static pass
+# (riptide_tpu.analysis.jaxpr_contract / tools/rprove.py) traces. Every
+# hook reuses the SAME branch predicates (_fused_eligible /
+# _kernel_eligible), the same _wire_parts split and the same
+# _run_stage_* queueing helpers the live `_queue_stages` dispatch runs
+# through, so a contract extracted here describes exactly the programs
+# a search queues — there is no second copy of the dispatch logic to
+# drift. Tracing (jax.make_jaxpr / AOT lowering) never executes device
+# work, so the hooks are backend-free: they run under JAX_PLATFORMS=cpu
+# with interpret-mode Pallas kernels and still describe the TPU
+# programs' shapes, dtypes and buffer footprints.
+
+
+def staged_wire_operands(plan, D, mode):
+    """Abstract operands (``jax.ShapeDtypeStruct``) of a D-trial
+    chunk's shipped wire — per-part buffers, plus the quantised modes'
+    scale plane — and the stage -> (part, part-relative offset) map:
+    the exact shapes :func:`ship_stage_data` puts on the device."""
+    parts_spec = _wire_parts(plan, mode)
+    part_of = {}
+    for c, (start, end, stages) in enumerate(parts_spec):
+        for i, off in stages:
+            part_of[i] = (c, off)
+    if mode in _WIRE_Q:
+        vl = _view_layout(plan, mode)
+        parts = [jax.ShapeDtypeStruct((D, end - start, vl["PW"]),
+                                      jnp.uint8)
+                 for start, end, _ in parts_spec]
+        scales = jax.ShapeDtypeStruct((D, vl["stot"], 1), jnp.float32)
+    else:
+        parts = [jax.ShapeDtypeStruct((D, end - start), jnp.dtype(mode))
+                 for start, end, _ in parts_spec]
+        scales = None
+    return parts, part_of, scales
+
+
+def _staged_meta(plan, path, mode):
+    """The wire meta dict of a hypothetical shipped chunk (no data,
+    layout bookkeeping only) — what the _run_stage_* helpers consume."""
+    offs, lens, _ = _wire_layout(plan, mode)
+    meta = {"path": path, "mode": mode, "offs": offs, "lens": lens,
+            "scales": None}
+    if mode in _WIRE_Q:
+        meta["view"] = _view_layout(plan, mode)
+    return meta
+
+
+def staged_stage_programs(plan, D, path=None, mode=None):
+    """The queued-stage lowering hook: one record per cascade stage of
+    a D-trial search of ``plan``, each a traceable description of the
+    device program(s) that stage queues:
+
+    ``{"stage": i, "kind": "fused" | "kernel" | "gather",
+       "fn": callable, "args": tuple of ShapeDtypeStruct,
+       "donate": argnums the program donates (empty today)}``
+
+    ``jax.make_jaxpr(fn)(*args)`` yields the stage's jaxpr without
+    executing anything; running ``fn`` also fires the engine's own
+    ``dispatch_<kind>`` metrics, so a tracer can count queued programs
+    by kind. ``path``/``mode`` default to the live selection
+    (:func:`_ffa_path` / :func:`_wire_mode`) but are explicit so
+    contracts pin the TPU kernel path from a CPU-only process."""
+    path = path or _ffa_path()
+    mode = mode or _wire_mode(path)
+    parts, part_of, scales = staged_wire_operands(plan, D, mode)
+    meta = _staged_meta(plan, path, mode)
+    records = []
+    for i, st in enumerate(plan.stages):
+        c, off = part_of[i]
+        part = parts[c]
+        if path == "kernel" and _fused_eligible(st, plan, mode):
+            kind, runner = "fused", _run_stage_fused
+        elif path == "kernel" and _kernel_eligible(st, plan):
+            kind, runner = "kernel", _run_stage_kernel
+        else:
+            kind, runner = "gather", _run_stage_unpack_gather
+        if scales is not None:
+            def fn(p, s, st=st, off=off, i=i, runner=runner):
+                return runner(st, p, off, plan, dict(meta, scales_dev=s),
+                              i)
+            args = (part, scales)
+        else:
+            def fn(p, st=st, off=off, i=i, runner=runner):
+                return runner(st, p, off, plan, meta, i)
+            args = (part,)
+        records.append({"stage": i, "kind": kind, "fn": fn,
+                        "args": args, "donate": ()})
+    return records
+
+
+def staged_chunk_program(plan, D, path=None, mode=None):
+    """The WHOLE queued device side of one D-trial chunk — every
+    cascade stage plus the device-side assembly — as one traceable
+    ``(fn, args)`` pair over the shipped wire operands. A buffer-
+    liveness walk of ``jax.make_jaxpr(fn)(*args)`` is the peak-HBM
+    model rprove pins and the batcher's model-seeded DM-batch pick
+    consumes (peak detection adds only fixed KB-sized buffers on top
+    and is deliberately out of model)."""
+    path = path or _ffa_path()
+    mode = mode or _wire_mode(path)
+    parts, part_of, scales = staged_wire_operands(plan, D, mode)
+    meta = _staged_meta(plan, path, mode)
+
+    if scales is not None:
+        def fn(*ops):
+            m = dict(meta, scales_dev=ops[-1])
+            outs, layout = _queue_stages(
+                plan, None, shipped=(list(ops[:-1]), part_of, m))
+            return _assemble_device(plan, layout, *outs)
+        args = tuple(parts) + (scales,)
+    else:
+        def fn(*ops):
+            outs, layout = _queue_stages(
+                plan, None, shipped=(list(ops), part_of, dict(meta)))
+            return _assemble_device(plan, layout, *outs)
+        args = tuple(parts)
+    return fn, args
+
+
+def wire_transfer_contract(plan, mode):
+    """Host<->device transfer shape of one chunk, exact from the wire
+    layout (no tracing): transfer count and bytes PER DM TRIAL, total
+    and per stage. The quantised modes ship the byte-plane view (+ one
+    scales transfer); float modes ship the flat element buffer."""
+    offs, lens, tot = _wire_layout(plan, mode)
+    nparts = len(_wire_parts(plan, mode))
+    if mode in _WIRE_Q:
+        vl = _view_layout(plan, mode)
+        per_stage = [int(vl["wrows"][i]) * vl["PW"]
+                     + int(vl["r0s"][i]) * 4
+                     for i in range(len(plan.stages))]
+        total = int(vl["tot_rows"]) * vl["PW"] + int(vl["stot"]) * 4
+        h2d = nparts + 1   # + the scale plane
+    else:
+        item = np.dtype(mode).itemsize
+        per_stage = [int(st.n) * item for st in plan.stages]
+        total = int(tot) * item
+        h2d = nparts
+    return {"h2d_transfers": int(h2d), "h2d_bytes_per_dm": int(total),
+            "per_stage_wire_bytes_per_dm": per_stage, "d2h_pulls": 1}
+
+
+def device_peak_bytes():
+    """Backend-reported peak device-memory bytes of this process
+    (``memory_stats()['peak_bytes_in_use']``), or None where the
+    backend exposes no memory stats (the CPU backend). The journal's
+    per-chunk ``hbm`` block pairs this with the jaxpr-contract model's
+    prediction so the model is calibratable against real runs."""
+    try:
+        devices = jax.local_devices()
+        if not devices:
+            return None
+        stats = devices[0].memory_stats() or {}
+    except Exception:
+        return None
+    peak = stats.get("peak_bytes_in_use")
+    return int(peak) if peak else None
 
 
 def prepare_batch(plan, batch):

@@ -273,14 +273,17 @@ class SurveyJournal:
         }, site="journal_append")
 
     def record_chunk(self, chunk_id, files, dms, peaks, wire_digest=None,
-                     timings=None, attempts=1, dq=None, extra=None):
+                     timings=None, attempts=1, dq=None, hbm=None,
+                     extra=None):
         """Journal one completed chunk. The peak rows are appended (and
         fsync'd) BEFORE the chunk record, so a chunk record always
         implies its peaks are durable. ``dq`` is the chunk's
         data-quality summary (masked samples / quarantined files) for
-        downstream provenance; ``extra`` merges additional provenance
-        fields into the record (e.g. the multihost layer's degraded
-        ``scope``/``process`` markers)."""
+        downstream provenance; ``hbm`` the predicted-vs-actual peak
+        device-memory block (:func:`riptide_tpu.obs.schema.hbm_block`,
+        empty while model seeding is off); ``extra`` merges additional
+        provenance fields into the record (e.g. the multihost layer's
+        degraded ``scope``/``process`` markers)."""
         offset = self._peak_store_len()
         _append_lines(self.peaks_path, [_peak_to_row(p) for p in peaks],
                       site="peaks_append")
@@ -293,7 +296,7 @@ class SurveyJournal:
             "wire_digest": wire_digest,
             "peaks_offset": offset, "peaks_count": len(peaks),
             "timings": timings or {}, "attempts": int(attempts),
-            "dq": dq or {},
+            "dq": dq or {}, "hbm": hbm or {},
         }
         rec.update(extra or {})
         _append_line(self.journal_path, rec, site="journal_append")

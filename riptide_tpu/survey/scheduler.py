@@ -668,6 +668,13 @@ class SurveyScheduler:
                     dq = {}
                     if hasattr(self.searcher, "chunk_dq_summary"):
                         dq = self.searcher.chunk_dq_summary(self.chunks[cid])
+                    # Predicted-vs-actual peak HBM next to the timing
+                    # block (empty while model seeding is off): the
+                    # calibration record of the jaxpr-contract model,
+                    # surfaced by rreport's hbm section.
+                    hbm = {}
+                    if hasattr(self.searcher, "chunk_hbm_block"):
+                        hbm = self.searcher.chunk_hbm_block(items) or {}
                     with span("journal", chunk=cid):
                         self.journal.record_chunk(
                             cid, self.chunks[cid],
@@ -675,6 +682,7 @@ class SurveyScheduler:
                              for ts in tslist],
                             peaks, wire_digest=digest,
                             timings=timing, attempts=attempts, dq=dq,
+                            hbm=hbm,
                         )
                 log.debug("chunk %d/%d done: %d peaks, %d attempt(s)",
                           cid + 1, len(self.chunks), len(peaks), attempts)

@@ -222,6 +222,27 @@ _ALL = [
         since="PR 9 (0.9.0)",
     ),
     EnvFlag(
+        "RIPTIDE_HBM_BUDGET", "int", 0,
+        "Peak device-HBM budget (bytes) for the model-seeded DM-batch "
+        "pick: when > 0, the batch searcher caps each queued DM batch "
+        "at the largest size the plan's traced peak-HBM model "
+        "(riptide_tpu/analysis/jaxpr_contract.py) predicts fits, so "
+        "OOM bisection becomes a fallback instead of the first resort "
+        "(`oom_predicted` counts proactive splits), and journaled "
+        "chunks carry a predicted-vs-actual `hbm` calibration block. "
+        "`0` disables seeding.",
+        since="PR 12 (0.12.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_PROVE_PLANS", "str", None,
+        "Comma-separated subset of contract plan names tools/rprove.py "
+        "verifies (see riptide_tpu/ops/plan.py CONTRACT_PLANS); unset "
+        "verifies every fast-tier plan and `rprove --all` adds the "
+        "slow tier. Read raw by tools/rprove.py before jax "
+        "configuration; the --plans CLI flag takes precedence.",
+        since="PR 12 (0.12.0)", scope="tools",
+    ),
+    EnvFlag(
         "RIPTIDE_BENCH_BUDGET", "float", 1380.0,
         "Total process wall-time budget (seconds) bench.py runs "
         "against: the first timed pass always emits a JSON line, "

@@ -1,0 +1,401 @@
+"""
+Tier-1 enforcement of the semantic static pass (rprove):
+
+* the checked-in plan contracts (tools/plan_contracts.json) match what
+  the tree's staged programs trace to — zero drift on the clean tree;
+* the queued-stage lowering hook AOT-lowers backend-free on CPU (no
+  device execution) and the buffer-liveness peak-HBM model is sane;
+* each seeded regression — an introduced extra dispatch, an f64
+  promotion, a dropped donation, an unplanned host transfer — makes
+  rprove exit 1 with a message naming the plan + stage (the paired
+  "good twin" is the clean-tree test above);
+* the HBM model SEEDS the DM-batch pick end-to-end on CPU: with an
+  injected OOM threshold the model respects, `oom_bisections` is 0,
+  `oom_predicted` counts the proactive split, peaks are byte-identical
+  to an unthrottled run, and the journal/rreport carry the
+  predicted-vs-actual `hbm` calibration block;
+* the rprove CLI contracts: --update pins, drift exits 1, missing file
+  exits 2, --format sarif reuses riplint's writer (driver "rprove",
+  the RPV rule set);
+* the riplint result cache invalidates on a plan_contracts.json edit
+  (the semantic pass's pinned artifact is a tracked input of `make
+  check`).
+
+The full (slow-tier) plan sweep runs behind ``-m slow``.
+"""
+import importlib.util
+import io
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from riptide_tpu.analysis import jaxpr_contract as jc
+from riptide_tpu.ops.plan import CONTRACT_PLANS, contract_plan_params
+from riptide_tpu.search import engine
+
+# Shared survey helpers + the fresh_metrics fixture (pytest registers
+# an imported fixture for this module too).
+from test_quality import (  # noqa: F401
+    RANGES, TSAMP, fresh_metrics, make_searcher, make_survey,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+RPROVE = os.path.join(REPO, "tools", "rprove.py")
+CONTRACTS = os.path.join(REPO, "tools", "plan_contracts.json")
+
+ALL_NAMES = [s["name"] for s in CONTRACT_PLANS]
+
+
+def _load_tool(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+rprove = _load_tool(RPROVE, "rprove_under_test")
+
+
+def _tiny_plan(name="tiny-gather"):
+    return jc.build_contract_plan(contract_plan_params([name])[0])
+
+
+# ----------------------------------------------------- plan enumeration
+
+def test_contract_plan_params_resolution():
+    fast = contract_plan_params(tiers=("fast",))
+    assert [s["name"] for s in fast] == ["tiny-gather", "tiny-fused"]
+    assert [s["name"] for s in contract_plan_params(["tiny-fused"])] \
+        == ["tiny-fused"]
+    both = contract_plan_params(tiers=("fast", "slow"))
+    assert len(both) == len(CONTRACT_PLANS)
+    with pytest.raises(KeyError, match="unknown contract plan"):
+        contract_plan_params(["renamed-away"])
+
+
+# --------------------------------------------- jaxpr walks (unit level)
+
+def test_peak_live_bytes_liveness():
+    """x dies after its last use, so the peak is two 256-float buffers,
+    not three."""
+    def f(x):
+        y = x * 2.0
+        return y + 1.0
+
+    closed = jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((256,), jnp.float32))
+    assert jc.peak_live_bytes(closed) == 2 * 256 * 4
+
+
+def test_f64_and_dtype_collection():
+    def ok(x):
+        return x + 1.0
+
+    def bad(x):
+        return x.astype(jnp.float64) + 1.0
+
+    sds = jax.ShapeDtypeStruct((8,), jnp.float32)
+    with jax.experimental.enable_x64():
+        assert jc.count_f64_eqns(jax.make_jaxpr(ok)(sds)) == 0
+        closed = jax.make_jaxpr(bad)(sds)
+        assert jc.count_f64_eqns(closed) >= 1
+        assert "float64" in jc.collect_dtypes(closed)
+
+
+def test_donation_report_honored_and_dropped():
+    sds = jax.ShapeDtypeStruct((64,), jnp.float32)
+    honored = jc.donation_report(lambda x, y: x + y, (sds, sds),
+                                 donate_argnums=(0,))
+    assert honored == {"donated": 1, "dropped": 0}
+    dropped = jc.donation_report(lambda x, y: (x + y)[:1], (sds, sds),
+                                 donate_argnums=(0,))
+    assert dropped == {"donated": 1, "dropped": 1}
+    assert jc.donation_report(lambda x: x, (sds,)) \
+        == {"donated": 0, "dropped": 0}
+
+
+# ------------------------------------------- the tiny CPU AOT-trace test
+
+def test_staged_chunk_program_aot_lowers_backend_free():
+    """The lowering hook's whole-chunk program AOT-lowers on the CPU
+    backend from abstract operands alone — no data, no device
+    execution — and the liveness walk over it yields a positive,
+    monotone HBM model."""
+    plan = _tiny_plan("tiny-gather")
+    fn, args = engine.staged_chunk_program(plan, 2, path="gather",
+                                           mode="float32")
+    assert all(isinstance(a, jax.ShapeDtypeStruct) for a in args)
+    lowered = jax.jit(fn).lower(*args)
+    assert lowered.as_text()  # stablehlo module produced, nothing ran
+
+    model = jc.hbm_model(plan, path="gather", mode="float32")
+    assert model.per_dm_bytes > 0
+    assert model.predict(8) > model.predict(1)
+    # Exactly-at-budget probes invert to the probed batch size.
+    assert model.max_batch(model.predict(3)) == 3
+    assert model.max_batch(0) == 1  # never below one trial
+    # A D-independent footprint must report "unbounded", not force
+    # maximal splitting (review regression).
+    flat = jc.HBMModel(1024, 0)
+    assert flat.max_batch(10 * 1024) > 1 << 40
+
+
+def test_extracted_contract_shape_fused_zero_pack(fresh_metrics):
+    """The fused path's contract: one fused program per eligible stage
+    lane bucket, ZERO pack programs, float32 assembled output."""
+    spec = contract_plan_params(["tiny-fused"])[0]
+    plan = jc.build_contract_plan(spec)
+    c = jc.extract_contract("tiny-fused", plan, path="kernel",
+                            mode="uint6")
+    assert c["n_stages"] == len(plan.stages) == len(c["stages"])
+    for st in c["stages"]:
+        assert st["kind"] == "fused"
+        assert st["dispatch"].get("fused", 0) >= 1
+        assert "pack" not in st["dispatch"]
+        assert st["f64_eqns"] == 0
+    assert c["dispatch_total"].get("pack", 0) == 0
+    assert c["out_dtype"] == "float32"
+    assert "float64" not in c["dtypes"]
+    assert c["transfers"]["h2d_bytes_per_dm"] > 0
+    assert c["hbm"]["per_dm_bytes"] > 0
+
+
+# ------------------------------------------------ clean-tree verification
+
+def test_contracts_zero_drift_on_clean_tree(fresh_metrics):
+    """The fast tier of `make prove`, in-process: the pinned contracts
+    match the tree (the paired 'good twin' of every seeded-regression
+    test below)."""
+    current = rprove.build_current(tiers=("fast",))
+    pinned = jc.load_contracts(CONTRACTS)
+    assert pinned is not None, "tools/plan_contracts.json missing"
+    findings = jc.check_contracts(pinned, current, ALL_NAMES)
+    assert findings == [], "\n".join(f["message"] for f in findings)
+
+
+@pytest.mark.slow
+def test_contracts_zero_drift_full_sweep(fresh_metrics):
+    """The full plan sweep (slow tier included): `rprove --all`."""
+    current = rprove.build_current(tiers=("fast", "slow"))
+    pinned = jc.load_contracts(CONTRACTS)
+    findings = jc.check_contracts(pinned, current, ALL_NAMES)
+    assert findings == [], "\n".join(f["message"] for f in findings)
+    assert set(pinned["plans"]) == set(ALL_NAMES)
+
+
+# ------------------------------------------------- seeded regressions
+#
+# Each seed doctors the live engine (monkeypatch, undone per test) and
+# asserts rprove exits 1 with a finding naming the plan + stage; the
+# clean-tree test above is the shared good twin.
+
+def _run_rprove(names):
+    out, err = io.StringIO(), io.StringIO()
+    code = rprove.run(names=names, out=out, err=err)
+    return code, out.getvalue(), err.getvalue()
+
+
+def test_seeded_extra_dispatch_exits_1(fresh_metrics, monkeypatch):
+    """Demote the fused stages to the two-dispatch pack+kernel form:
+    the pack programs the fused path eliminated reappear and the
+    dispatch contract drifts."""
+    monkeypatch.setattr(engine, "_fused_eligible",
+                        lambda st, plan, mode: False)
+    code, out, _ = _run_rprove(["tiny-fused"])
+    assert code == 1
+    assert "RPV001" in out and "tiny-fused" in out
+    assert "stage 0" in out and "dispatch drift" in out
+    assert "pack" in out
+
+
+def test_seeded_f64_promotion_exits_1(fresh_metrics, monkeypatch):
+    """Promote a gather stage's output to float64: the dtype-flow
+    audit catches it (absolute — --update could not bless it)."""
+    orig = engine._run_stage_unpack_gather
+
+    def promoted(st, part, off, plan, meta, i):
+        with jax.experimental.enable_x64():
+            return orig(st, part, off, plan, meta, i).astype(jnp.float64)
+
+    monkeypatch.setattr(engine, "_run_stage_unpack_gather", promoted)
+    code, out, _ = _run_rprove(["tiny-gather"])
+    assert code == 1
+    assert "RPV002" in out and "tiny-gather" in out
+    assert "stage 0" in out and "float64" in out
+
+
+def test_seeded_dropped_donation_exits_1(fresh_metrics, monkeypatch):
+    """Declare stage 0's wire part donated: its output has a different
+    shape, so XLA drops the donation — rprove reports the silent
+    double-count."""
+    orig = engine.staged_stage_programs
+
+    def with_donation(plan, D, path=None, mode=None):
+        recs = orig(plan, D, path=path, mode=mode)
+        recs[0] = dict(recs[0], donate=(0,))
+        return recs
+
+    monkeypatch.setattr(engine, "staged_stage_programs", with_donation)
+    code, out, _ = _run_rprove(["tiny-gather"])
+    assert code == 1
+    assert "RPV003" in out and "tiny-gather" in out
+    assert "stage 0" in out and "dropped" in out
+
+
+def test_seeded_unplanned_transfer_exits_1(fresh_metrics, monkeypatch):
+    """Close an extra host array over a stage's program: it becomes a
+    per-dispatch constant transfer and the operand-bytes contract
+    drifts."""
+    orig = engine._run_stage_unpack_gather
+    stowaway = np.ones((7,), np.float32)
+
+    def smuggling(st, part, off, plan, meta, i):
+        return orig(st, part, off, plan, meta, i) \
+            + jnp.asarray(stowaway[:1])
+
+    monkeypatch.setattr(engine, "_run_stage_unpack_gather", smuggling)
+    code, out, _ = _run_rprove(["tiny-gather"])
+    assert code == 1
+    assert "RPV004" in out and "tiny-gather" in out
+    assert "stage 0" in out and "operand bytes drift" in out
+
+
+# ------------------------------------------------------- checker units
+
+def test_check_contracts_set_rules():
+    pinned = {"plans": {"gone-plan": {"stages": []}}}
+    findings = jc.check_contracts(pinned, {}, ALL_NAMES)
+    assert len(findings) == 1 and findings[0]["rule"] == "RPV006"
+    assert "gone-plan" in findings[0]["message"]
+
+    current = rprove.build_current(["tiny-gather"])
+    findings = jc.check_contracts({"plans": {}}, current, ALL_NAMES)
+    assert any(f["rule"] == "RPV006" and "tiny-gather" in f["message"]
+               and "--update" in f["message"] for f in findings)
+
+
+# ---------------------------------------------------------- CLI surface
+
+def test_cli_update_then_clean_then_missing(tmp_path, fresh_metrics):
+    custom = tmp_path / "contracts.json"
+    # Missing contract file: exit 2 with guidance.
+    assert rprove.run(contracts_path=str(custom), names=["tiny-gather"],
+                      out=io.StringIO(), err=io.StringIO()) == 2
+    # --update pins; a fresh check against the pin is clean.
+    assert rprove.run(contracts_path=str(custom), names=["tiny-gather"],
+                      update=True, out=io.StringIO(),
+                      err=io.StringIO()) == 0
+    doc = json.loads(custom.read_text())
+    assert set(doc["plans"]) == {"tiny-gather"}
+    err = io.StringIO()
+    assert rprove.run(contracts_path=str(custom), names=["tiny-gather"],
+                      out=io.StringIO(), err=err) == 0
+    assert "rprove OK" in err.getvalue()
+
+
+def test_cli_sarif_reuses_riplint_writer(fresh_metrics):
+    out = io.StringIO()
+    code = rprove.run(names=["tiny-gather"], fmt="sarif", out=out,
+                      err=io.StringIO())
+    assert code == 0
+    doc = json.loads(out.getvalue())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "rprove"
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == \
+        [f"RPV{n:03d}" for n in range(1, 7)]
+    assert run["results"] == []
+
+
+def test_make_targets_wire_prove_into_check_full():
+    with open(os.path.join(REPO, "Makefile")) as fobj:
+        mk = fobj.read()
+    assert "\nprove:" in mk
+    check_full = mk.split("check-full:")[1].split("\n\n")[0]
+    assert "tools/rprove.py" in check_full
+    assert "lint: check-full sanitize" in mk
+
+
+def test_riplint_cache_invalidates_on_contract_edit():
+    """tools/plan_contracts.json is a tracked input of the riplint
+    result cache: touching it must force a fresh run."""
+    riplint = _load_tool(os.path.join(REPO, "tools", "riplint.py"),
+                         "riplint_for_rprove_tests")
+    assert riplint.run(out=io.StringIO(), err=io.StringIO()) == 0
+    err = io.StringIO()
+    assert riplint.run(out=io.StringIO(), err=err) == 0
+    assert "[cached]" in err.getvalue()
+    os.utime(CONTRACTS)
+    err2 = io.StringIO()
+    assert riplint.run(out=io.StringIO(), err=err2) == 0
+    assert "[cached]" not in err2.getvalue()
+
+
+# ------------------------------------- model-seeded batching, end to end
+
+def test_hbm_model_seeds_batch_journal_and_report(tmp_path,
+                                                  fresh_metrics,
+                                                  monkeypatch):
+    """CPU e2e of the model-seeded DM-batch pick: with an injected OOM
+    threshold at 2 trials and a budget the model maps to a 2-trial cap,
+    the 4-trial chunk splits PROACTIVELY — zero oom_bisections, the
+    split counted as oom_predicted, peaks byte-identical to an
+    unthrottled run — and the journal + rreport carry the
+    predicted-vs-actual hbm calibration block."""
+    from riptide_tpu.analysis.jaxpr_contract import hbm_model
+    from riptide_tpu.obs.report import build_report, render_text
+    from riptide_tpu.survey.faults import FaultPlan
+    from riptide_tpu.survey.journal import SurveyJournal
+    from riptide_tpu.survey.scheduler import SurveyScheduler
+
+    amps = {0.0: 15.0, 5.0: 25.0, 10.0: 40.0, 15.0: 15.0}
+    files = make_survey(tmp_path, amps)
+
+    clean = make_searcher().process_fname_list(files)
+    assert fresh_metrics.counter("oom_bisections") == 0
+
+    searcher = make_searcher(faults=FaultPlan.parse("oom:2"))
+    nsamp = 16000  # TOBS / TSAMP of the synthetic survey files
+    plan = searcher._plan_for(RANGES[0], nsamp, TSAMP)
+    budget = hbm_model(plan).predict(2)
+    assert hbm_model(plan).max_batch(budget) == 2
+    monkeypatch.setenv("RIPTIDE_HBM_BUDGET", str(budget))
+
+    scheduler = SurveyScheduler(
+        searcher, [files], journal=SurveyJournal(tmp_path / "journal"),
+        faults=searcher.faults,
+    )
+    peaks = scheduler.run()
+    assert sorted(peaks) == sorted(clean)
+    # The model seeded the split; the OOM fault (armed above 2 trials)
+    # never fired and bisection never ran.
+    assert fresh_metrics.counter("oom_bisections") == 0
+    assert fresh_metrics.counter("oom_predicted") >= 1
+
+    journal = SurveyJournal(tmp_path / "journal")
+    (rec, _), = journal.completed_chunks().values()
+    assert rec["hbm"]["predicted_bytes"] > 0
+    assert rec["hbm"]["budget_bytes"] == budget
+    # CPU backend exposes no memory stats: actual stays absent here
+    # and is filled on real hardware.
+    report = build_report(str(tmp_path / "journal"))
+    assert report["hbm"]["n_modelled"] == 1
+    assert report["hbm"]["predicted_bytes_max"] > 0
+    assert report["hbm"]["budget_bytes"] == budget
+    assert "hbm model:" in render_text(report)
+
+
+def test_hbm_block_disabled_without_budget(fresh_metrics, monkeypatch):
+    """Seeding off (no budget): no hbm block, no proactive split, the
+    journal record carries an empty dict (pre-0.12 reader shape)."""
+    monkeypatch.delenv("RIPTIDE_HBM_BUDGET", raising=False)
+    bs = make_searcher()
+    assert bs.chunk_hbm_block([]) is None
+    assert bs._seed_batch_limit(_tiny_plan(), 1024) is None

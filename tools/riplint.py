@@ -85,7 +85,12 @@ def _tracked_files(repo):
     analyzer's output: the package sources, the out-of-package surfaces
     the analyzers read (tools/, tests/, bench.py, Makefile — RIP003's
     stale-flag scan and RIP010's tools-side readers), the generated
-    env-flag docs (RIP003 drift) and the baseline itself."""
+    env-flag docs (RIP003 drift) and the baseline itself. The tools/
+    walk also covers ``tools/plan_contracts.json`` (the semantic
+    pass's pinned contracts) and the package walk the rprove analysis
+    sources (``analysis/jaxpr_contract.py``), so a contract edit or an
+    extractor edit invalidates cached `make check` runs like any other
+    tracked change."""
     out = []
     for root in ("riptide_tpu", "tools", "tests"):
         top = os.path.join(repo, root)
@@ -185,9 +190,11 @@ def _save_cached_result(repo, key, result):
 
 # -- output formats ----------------------------------------------------------
 
-def _sarif_doc(result, analyzers):
+def _sarif_doc(result, analyzers, tool="riplint"):
     """One SARIF 2.1.0 run: the analyzer set as rule metadata, each new
-    finding (and stale baseline entry) as a result."""
+    finding (and stale baseline entry) as a result. ``tool`` names the
+    driver — tools/rprove.py reuses this writer for the semantic pass,
+    so both analyzers publish one result format."""
     rules = [
         {
             "id": a.rule,
@@ -233,7 +240,7 @@ def _sarif_doc(result, analyzers):
             # absolute URI and this tool has no canonical public URL
             # (docs/static_analysis.md is the in-repo reference).
             "tool": {"driver": {
-                "name": "riplint",
+                "name": tool,
                 "rules": rules,
             }},
             "results": results,

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""
+rprove: verify the jaxpr-level program contracts of the search plans.
+
+The SEMANTIC counterpart of riplint: where riplint walks the AST, this
+tool abstractly traces (``jax.make_jaxpr`` / AOT lowering — no device
+execution, backend-free under ``JAX_PLATFORMS=cpu``) every staged
+computation the engine queues for the representative plan set
+(``riptide_tpu.ops.plan.CONTRACT_PLANS``) and compares the extracted
+program contracts — dispatch counts by kind, the buffer-liveness
+peak-HBM model, the dtype-flow audit, host<->device transfer bytes,
+donation verification — against the pinned
+``tools/plan_contracts.json``. See
+``riptide_tpu/analysis/jaxpr_contract.py`` and
+docs/static_analysis.md ("Semantic pass").
+
+Exit status 0 on zero drift; 1 on any drift or absolute violation
+(float64 in a traced program, a dropped donation, a pack program on a
+fused stage); 2 when the contract file is missing. The workflow is
+``kernel_digest.json``'s: after a DELIBERATE change to the traced
+programs, re-pin with ``--update`` and commit the diff.
+
+``--format sarif`` reuses riplint's SARIF 2.1.0 writer, so both
+analyzers publish one result format for CI annotation uploads.
+``--all`` adds the slow-tier (survey-shaped) plans; ``--plans A,B``
+(or ``RIPTIDE_PROVE_PLANS``) restricts to named plans for quick local
+runs. Contracts are pinned under DEFAULT env semantics: path/wire/
+kernel-shape overrides (``RIPTIDE_FFA_PATH`` etc.) are dropped from
+the environment before tracing.
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+# Runnable as `python tools/rprove.py` from an uninstalled checkout.
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+DEFAULT_CONTRACTS = os.path.join(REPO, "tools", "plan_contracts.json")
+CONTRACT_REL = "tools/plan_contracts.json"
+
+# Env overrides that change plan geometry or dispatch structure:
+# contracts describe the DEFAULT semantics, so these are dropped before
+# the package configures itself.
+_CONTRACT_ENV = ("RIPTIDE_FFA_PATH", "RIPTIDE_WIRE_DTYPE",
+                 "RIPTIDE_KERNEL_LANE_SPLIT", "RIPTIDE_KERNEL_BASE3",
+                 "RIPTIDE_KERNEL_RESIDENT")
+
+
+def _force_cpu():
+    """Tracing is backend-free: pin the CPU backend (both the env form
+    and — for processes whose sitecustomize already imported jax — the
+    post-import config form) and neutralise contract-changing env
+    overrides."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    for k in _CONTRACT_ENV:
+        os.environ.pop(k, None)
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialised (e.g. under pytest): fine
+
+
+def load_riplint():
+    """tools/riplint.py loaded by file path — rprove reuses its SARIF
+    writer so both analyzers publish one result format."""
+    name = "riplint_for_rprove"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(HERE, "riplint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        del sys.modules[name]
+        raise
+    return mod
+
+
+class _Rule:
+    """SARIF rule-metadata shim matching the Analyzer attributes
+    riplint's writer reads."""
+
+    def __init__(self, rule, name, description):
+        self.rule = rule
+        self.name = name
+        self.description = description
+
+
+def _rules():
+    from riptide_tpu.analysis.jaxpr_contract import RULES
+
+    return [_Rule(*r) for r in RULES]
+
+
+def build_current(names=None, tiers=("fast",)):
+    """Freshly-extracted contracts for the selected plan set."""
+    _force_cpu()
+    from riptide_tpu.analysis import jaxpr_contract as jc
+    from riptide_tpu.ops.plan import contract_plan_params
+
+    out = {}
+    for spec in contract_plan_params(names, tiers=tiers):
+        plan = jc.build_contract_plan(spec)
+        out[spec["name"]] = jc.extract_contract(
+            spec["name"], plan, path=spec["path"], mode=spec["wire"])
+    return out
+
+
+def run(contracts_path=DEFAULT_CONTRACTS, names=None, all_tiers=False,
+        update=False, fmt="text", out=sys.stdout, err=sys.stderr):
+    """Extract, compare (or re-pin), emit; returns the exit code."""
+    tiers = ("fast", "slow") if all_tiers else ("fast",)
+    current = build_current(names, tiers)
+    from riptide_tpu.analysis import jaxpr_contract as jc
+    from riptide_tpu.ops.plan import CONTRACT_PLANS
+
+    all_names = [s["name"] for s in CONTRACT_PLANS]
+    pinned = jc.load_contracts(contracts_path)
+
+    if update:
+        doc = pinned or {"version": 1, "plans": {}}
+        doc["plans"].update(current)
+        # A renamed/removed plan spec takes its pinned entry with it.
+        doc["plans"] = {k: v for k, v in sorted(doc["plans"].items())
+                        if k in all_names}
+        with open(contracts_path, "w") as fobj:
+            json.dump(doc, fobj, indent=1, sort_keys=True)
+            fobj.write("\n")
+        print(f"pinned {len(current)} contract(s) "
+              f"({len(doc['plans'])} total) to "
+              f"{os.path.relpath(contracts_path, REPO)}", file=err)
+        return 0
+
+    if pinned is None:
+        print(f"rprove: no contract file at {contracts_path!r}; run "
+              "`python tools/rprove.py --update --all` and commit it",
+              file=err)
+        return 2
+
+    findings = jc.check_contracts(pinned, current, all_names,
+                                  contract_rel=CONTRACT_REL)
+    if fmt == "sarif":
+        riplint = load_riplint()
+        doc = riplint._sarif_doc({"new": findings, "stale": []},
+                                 _rules(), tool="rprove")
+        json.dump(doc, out, indent=2)
+        out.write("\n")
+    else:
+        for f in findings:
+            print(f"{f['path']}:{f['line']}:{f['col']}: {f['rule']} "
+                  f"{f['message']}", file=out)
+    n_stages = sum(len(c["stages"]) for c in current.values())
+    if findings:
+        print(f"rprove: {len(findings)} contract violation(s) over "
+              f"{len(current)} plan(s) / {n_stages} staged program(s)",
+              file=err)
+        return 1
+    print(f"rprove OK: {len(current)} plan contract(s) verified "
+          f"({n_stages} staged programs traced, zero drift)", file=err)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="rprove", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--contracts", default=DEFAULT_CONTRACTS,
+                    help="pinned contract file (default "
+                         "tools/plan_contracts.json)")
+    ap.add_argument("--update", action="store_true",
+                    help="re-pin the selected plans' contracts (the "
+                         "kernel_digest workflow: commit the diff)")
+    ap.add_argument("--all", action="store_true", dest="all_tiers",
+                    help="include the slow-tier (survey-shaped) plans")
+    ap.add_argument("--plans", default=None,
+                    help="comma-separated plan-name subset (default: "
+                         "the RIPTIDE_PROVE_PLANS env flag, else every "
+                         "selected-tier plan)")
+    ap.add_argument("--format", choices=("text", "sarif"),
+                    default="text", dest="fmt",
+                    help="output format: GitHub-annotation text "
+                         "(default) or one SARIF 2.1.0 run (riplint's "
+                         "writer)")
+    args = ap.parse_args(argv)
+
+    plans = args.plans or os.environ.get("RIPTIDE_PROVE_PLANS")
+    names = [p.strip() for p in plans.split(",") if p.strip()] \
+        if plans else None
+    return run(contracts_path=args.contracts, names=names,
+               all_tiers=args.all_tiers, update=args.update,
+               fmt=args.fmt)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
