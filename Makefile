@@ -3,7 +3,7 @@
 PYTHON ?= python
 
 .PHONY: install check check-full prove lint native-asan sanitize tests \
-	tests-cov native bench trace-demo report-demo chaos clean
+	tests-cov native bench trace-demo report-demo watch-demo chaos clean
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -27,8 +27,9 @@ check:
 prove:
 	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py
 
-# The CI form: AST analyzers uncached + the semantic pass.
-check-full:
+# The CI form: AST analyzers uncached + the semantic pass + the fleet/
+# alert e2e acceptance (watch-demo).
+check-full: watch-demo
 	$(PYTHON) tools/riplint.py --no-cache
 	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py
 
@@ -98,6 +99,18 @@ trace-demo:
 # (see docs/observability.md).
 report-demo:
 	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) tools/report_demo.py
+
+# Fleet/alert e2e acceptance (PR 14): a two-process CPU survey
+# federating fleet_<p>.json sidecars into one run directory, with an
+# injected straggle fault — tools/rwatch.py (another process) must see
+# the straggler_ratio alert fire then resolve and exit 0, the /status
+# fleet block must merge both processes, the
+# riptide_alert_active{rule=...} gauge must be observed live, and an
+# injected ENOSPC on every fleet write must leave the survey complete
+# with byte-identical peaks (obs writes are never fatal). Wired into
+# check-full.
+watch-demo:
+	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) tools/watch_demo.py
 
 # Storage-chaos campaign: a tiny CPU survey run as subprocess legs that
 # are KILLED mid-write at journal/ledger/cache boundaries (plus

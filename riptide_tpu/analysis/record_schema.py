@@ -60,10 +60,22 @@ WRITER_SPECS = (
      None),
     ("riptide_tpu/survey/journal.py", "SurveyJournal.record_incident",
      "incident"),
+    ("riptide_tpu/survey/journal.py", "SurveyJournal.record_alert",
+     "alert"),
     ("riptide_tpu/survey/journal.py", "SurveyJournal.heartbeat",
      "heartbeat"),
     ("riptide_tpu/survey/incidents.py", "emit", "incident"),
     ("riptide_tpu/obs/ledger.py", "make_row", "ledger"),
+    # The alert engine's fire/resolve record (PR 14): journaled
+    # verbatim by record_alert and consumed by report/rtop/rwatch.
+    ("riptide_tpu/obs/alerts.py", "AlertEngine._event", "alert"),
+    # The per-process fleet snapshot sidecar (PR 14): written by
+    # obs/fleet.py, merged by report.read_fleet/merge_fleet.
+    ("riptide_tpu/obs/fleet.py", "snapshot", "fleet"),
+    # The live signal vector the alert rules evaluate (PR 14): built
+    # by the reader side but CONSUMED as a record by the rule engine
+    # and rwatch, so its keys are part of the checked schema.
+    ("riptide_tpu/obs/report.py", "watch_snapshot", "watch"),
     ("riptide_tpu/obs/schema.py", "chunk_timing", "timing"),
     ("riptide_tpu/obs/schema.py", "decomposition", "ledger"),
     # The chunk record's predicted-vs-actual peak-HBM block (PR 12).
@@ -87,6 +99,7 @@ READER_SPECS = (
     ("riptide_tpu/survey/liveness.py", "PeerLivenessMonitor.partial_chunks"),
     ("riptide_tpu/obs/report.py", None),
     ("tools/rtop.py", None),
+    ("tools/rwatch.py", None),
 )
 
 # Versioned backward-compat allowlist: keys readers must keep accepting

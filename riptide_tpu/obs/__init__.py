@@ -55,14 +55,21 @@ from .chrome import (  # noqa: F401
 )
 from .prom import (  # noqa: F401
     health_check, maybe_serve, maybe_write_textfile, render, serve,
-    set_status_provider, status_snapshot, write_prom,
+    set_fleet_source, set_status_provider, status_snapshot, write_prom,
 )
 from .ledger import (  # noqa: F401
     append_row, make_row, maybe_append, read_rows,
 )
+from .alerts import (  # noqa: F401
+    AlertEngine, AlertRule, default_rules, get_engine, install_engine,
+    rules_from_spec,
+)
+from .fleet import (  # noqa: F401
+    FLEET_VERSION, fleet_path, write_snapshot,
+)
 from .report import (  # noqa: F401
-    build_report, compare_to_ledger, render_text,
-    run_decomposition_from_chunks,
+    build_report, compare_to_ledger, merge_fleet, read_fleet,
+    render_text, run_decomposition_from_chunks, watch_snapshot,
 )
 from .schema import (  # noqa: F401
     CHUNK_TIMING_KEYS, DECOMPOSITION_KEYS, LEGACY_ALIASES, PHASES,
@@ -75,9 +82,14 @@ __all__ = [
     "chrome_events", "write_chrome_trace", "merge_chrome_traces",
     "export_run_trace", "rotate_trace_file",
     "render", "write_prom", "serve", "maybe_serve", "maybe_write_textfile",
-    "set_status_provider", "status_snapshot", "health_check",
+    "set_status_provider", "set_fleet_source", "status_snapshot",
+    "health_check",
     "make_row", "append_row", "maybe_append", "read_rows",
+    "AlertEngine", "AlertRule", "default_rules", "rules_from_spec",
+    "install_engine", "get_engine",
+    "FLEET_VERSION", "fleet_path", "write_snapshot",
     "build_report", "render_text", "compare_to_ledger",
+    "read_fleet", "merge_fleet", "watch_snapshot",
     "run_decomposition_from_chunks",
     "TIMING_VERSION", "PHASES", "DECOMPOSITION_KEYS", "CHUNK_TIMING_KEYS",
     "LEGACY_ALIASES", "decomposition", "chunk_timing", "classify_bound",

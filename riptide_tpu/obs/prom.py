@@ -36,16 +36,19 @@ plumbing and the lint/daemon layers load it standalone.
 import json
 import logging
 import os
+import sys
 import threading
 
 from ..survey.metrics import get_metrics
 from ..utils import envflags, fsio
+from .alerts import get_engine
 
 log = logging.getLogger("riptide_tpu.obs.prom")
 
 __all__ = ["render", "write_prom", "serve", "maybe_serve",
            "maybe_write_textfile", "set_status_provider",
-           "status_snapshot", "health_check", "PROM_PREFIX", "ENDPOINTS"]
+           "set_fleet_source", "status_snapshot", "health_check",
+           "PROM_PREFIX", "ENDPOINTS"]
 
 # Every path the daemon answers; the 404 body enumerates them so a
 # mistyped scrape target is self-diagnosing.
@@ -92,10 +95,23 @@ def _fmt(value):
     return repr(float(value))
 
 
-def render(registry=None):
+def render(registry=None, fleet=None):
     """The full text-format page of one registry snapshot (counters,
     gauges, histograms — timers are covered by their histograms, whose
-    ``_sum`` equals the timer total by construction)."""
+    ``_sum`` equals the timer total by construction), plus two
+    federated sections:
+
+    * **fleet series**: with per-process fleet snapshots available
+      (``fleet`` dict, or the installed :func:`set_fleet_source`),
+      progress and health counters render once per process under a
+      ``process`` label — one scrape of any member exposes the whole
+      run (``riptide_fleet_chunks_done{process="1"} 3`` ...);
+    * **alert gauge**: with a process-wide alert engine installed
+      (:func:`riptide_tpu.obs.alerts.install_engine`), every
+      configured rule renders as
+      ``riptide_alert_active{rule="..."}`` 0/1 — explicit zeros, so a
+      recording rule can watch for the flip rather than for series
+      appearing."""
     snap = (registry or get_metrics()).snapshot()
     lines = []
 
@@ -126,7 +142,102 @@ def render(registry=None):
         lines.append(f"{name}_sum {_fmt(h['sum'])}")
         lines.append(f"{name}_count {h['count']}")
 
+    if fleet is None:
+        with _fleet_lock:
+            source = _fleet_source
+        if source is not None:
+            try:
+                fleet = source()
+            except Exception as err:
+                log.warning("fleet source failed: %s", err)
+    if fleet:
+        lines.extend(_fleet_lines(fleet))
+
+    engine = get_engine()
+    if engine is not None:
+        name = f"{PROM_PREFIX}_alert_active"
+        lines.append(f"# HELP {name} 1 while the alert rule is firing "
+                     "(riptide_tpu.obs.alerts)")
+        lines.append(f"# TYPE {name} gauge")
+        for rule, active in sorted(engine.active().items()):
+            lines.append(f'{name}{{rule="{rule}"}} {1 if active else 0}')
+
     return "\n".join(lines) + "\n"
+
+
+# Per-process fleet fields federated onto the page, and their series
+# suffix + TYPE. Staleness is exported as the snapshot's raw unix
+# timestamp (the node_exporter textfile convention): a recording rule
+# computes `time() - riptide_fleet_snapshot_timestamp_seconds`, and
+# the page itself stays deterministic for unchanged sidecars (the
+# textfile writer's atomic page can be byte-compared to a re-render).
+_FLEET_GAUGES = (
+    ("chunks_done", "fleet_chunks_done",
+     "chunks this process completed"),
+    ("chunks_parked", "fleet_chunks_parked",
+     "chunks this process parked"),
+    ("rate_chunks_per_s", "fleet_chunk_rate",
+     "this process's recent chunk completion rate (1/s)"),
+    ("running", "fleet_running",
+     "1 while this process reports its survey running"),
+)
+
+
+def _fleet_lines(fleet):
+    """The per-process federation section: every snapshot's progress
+    gauges, snapshot timestamp and health counters under a ``process``
+    label."""
+    lines = []
+    by_name = {}
+    for p in sorted(fleet):
+        snap = fleet[p]
+        label = f'process="{int(p)}"'
+        for key, suffix, help_text in _FLEET_GAUGES:
+            val = snap.get(key)
+            if val is None:
+                continue
+            by_name.setdefault(
+                (f"{PROM_PREFIX}_{suffix}", "gauge", help_text),
+                []).append((label, float(val)))
+        ts = snap.get("ts")
+        if ts is not None:
+            by_name.setdefault(
+                (f"{PROM_PREFIX}_fleet_snapshot_timestamp_seconds",
+                 "gauge",
+                 "unix time this process last rewrote its fleet "
+                 "snapshot (staleness = time() - this)"),
+                []).append((label, float(ts)))
+        # Whatever health counters the sidecar carries (the snapshot
+        # writer — obs/fleet.py — owns the key set).
+        counters = snap.get("counters") or {}
+        for key in sorted(counters):
+            by_name.setdefault(
+                (f"{PROM_PREFIX}_fleet_{key}_total", "counter",
+                 f"this process's {key} counter"),
+                []).append((label, float(counters[key])))
+    for (name, kind, help_text), series in by_name.items():
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        for label, val in series:
+            lines.append(f"{name}{{{label}}} {_fmt(val)}")
+    return lines
+
+
+# Process-wide fleet source: a zero-argument callable returning
+# {process_index: snapshot} (normally `lambda: report.read_fleet(jdir)`
+# installed by the survey scheduler for the run's duration), so the
+# /metrics page federates the whole run's processes.
+_fleet_source = None
+_fleet_lock = threading.Lock()
+
+
+def set_fleet_source(source):
+    """Install ``source()`` as the fleet-snapshot supplier for the
+    exposition page (None uninstalls); returns the previous source."""
+    global _fleet_source
+    with _fleet_lock:
+        prev, _fleet_source = _fleet_source, source
+    return prev
 
 
 def write_prom(path, registry=None):
@@ -302,17 +413,44 @@ _server = None
 _server_lock = threading.Lock()
 
 
-def maybe_serve(registry=None):
+def _detect_process_index():
+    """This process's distributed index, WITHOUT importing jax: only a
+    process that already initialized it can have a nonzero index, so
+    an absent (or uninitialized) jax module means 0. Keeps this module
+    importable — and the single-process fast path free — on jax-less
+    monitor nodes."""
+    mod = sys.modules.get("jax")
+    if mod is None:
+        return 0
+    try:
+        return int(mod.process_index())
+    except Exception:
+        return 0
+
+
+def maybe_serve(registry=None, process_index=None):
     """Start the process-wide endpoint when ``RIPTIDE_PROM_PORT`` > 0
     and none is running yet; returns the server or None. Survey entry
     points call this unconditionally — the disabled path is one flag
     read. A caller with an explicit ``registry`` re-points a running
     endpoint (last caller wins), so a scheduler constructed with its
-    own registry is the one a scraper sees during its run."""
+    own registry is the one a scraper sees during its run.
+
+    With ``RIPTIDE_PROM_PORT_OFFSET`` (the default), the bound port is
+    ``RIPTIDE_PROM_PORT + process_index`` (auto-detected from the jax
+    distributed runtime when not passed): two processes sharing one
+    host no longer race to bind the same port and silently lose one
+    endpoint — each gets a deterministic, documented port of its own.
+    ``0`` restores the literal-port behaviour (e.g. behind a
+    per-process port map)."""
     global _server
     port = envflags.get("RIPTIDE_PROM_PORT")
     if not port or port <= 0:
         return _server
+    if envflags.get("RIPTIDE_PROM_PORT_OFFSET"):
+        if process_index is None:
+            process_index = _detect_process_index()
+        port += int(process_index)
     with _server_lock:
         if _server is None:
             _server = serve(port, registry=registry)

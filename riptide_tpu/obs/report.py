@@ -32,6 +32,7 @@ a running survey or auditing a ledger never needs a jax install.
 import glob
 import json
 import os
+import time
 import zlib
 
 __all__ = [
@@ -39,8 +40,9 @@ __all__ = [
     "read_heartbeats", "read_ledger", "parse_prom_text",
     "load_trace_summary", "run_decomposition_from_chunks",
     "phase_attribution", "stragglers", "tunnel_stats", "hbm_stats",
-    "build_report",
-    "render_text", "compare_to_ledger", "latest_platform",
+    "read_fleet", "merge_fleet", "watch_snapshot", "build_report",
+    "render_text", "render_fleet_text", "compare_to_ledger",
+    "latest_platform",
     "drop_own_row", "strip_checksum", "parse_record_line",
 ]
 
@@ -152,6 +154,7 @@ class JournalFollower:
     def _reset(self):
         self._header = None
         self._chunks, self._parked, self._incidents = {}, {}, []
+        self._alerts = []
         self._metrics = None
 
     def _fold(self, rec):
@@ -164,6 +167,11 @@ class JournalFollower:
             self._parked[int(rec.get("chunk_id", -1))] = rec
         elif kind == "incident":
             self._incidents.append(rec)
+        elif kind == "alert":
+            # PR 14 alert-engine fire/resolve records; invisible to
+            # kind-filtering pre-PR-14 readers, and journals without
+            # them simply yield an empty timeline.
+            self._alerts.append(rec)
         elif kind == "metrics":
             self._metrics = rec.get("summary", self._metrics)
 
@@ -195,6 +203,7 @@ class JournalFollower:
         return {"directory": self.directory, "header": self._header,
                 "chunks": dict(self._chunks), "parked": parked,
                 "incidents": list(self._incidents),
+                "alerts": list(self._alerts),
                 "metrics": self._metrics}
 
 
@@ -231,6 +240,191 @@ def read_heartbeats(journal_dir, tail_bytes=4096):
 def read_ledger(path):
     """Every parseable ledger row, oldest first (see obs.ledger)."""
     return _read_jsonl(path)
+
+
+# ----------------------------------------------------------------- fleet
+#
+# Per-process status sidecars: each process of a run atomically rewrites
+# `fleet_<p>.json` next to the journal after every chunk (see
+# riptide_tpu.obs.fleet — the writer half). A reader merges whatever
+# sidecars exist into ONE fleet view, so the multi-host bench reports
+# through the same pipeline as single-process runs. A process slower
+# than this fraction of the fleet's median chunk rate is a straggler.
+
+FLEET_STRAGGLER_FRAC = 0.5
+# A sidecar older than this (seconds) marks its process stale in the
+# merged view (rtop/rreport skew highlighting; the alert layer applies
+# its own configurable staleness budget).
+FLEET_STALE_S = 120.0
+
+
+def read_fleet(journal_dir):
+    """``{process_index: snapshot dict}`` from the ``fleet_*.json``
+    sidecars of a journal directory. Sidecars are whole-file atomic
+    writes (never torn); unparseable or foreign files are skipped, and
+    a directory without any — every pre-fleet journal — reads as an
+    empty fleet."""
+    out = {}
+    for path in glob.glob(os.path.join(journal_dir, "fleet_*.json")):
+        try:
+            with open(path, "rb") as fobj:
+                raw = fobj.read()
+        except OSError:
+            continue
+        obj = parse_record_line(raw.strip())
+        if not isinstance(obj, dict):
+            continue
+        try:
+            out[int(obj["process"])] = obj
+        except (KeyError, TypeError, ValueError):
+            # Foreign/hand-edited file matching the glob: skip, per
+            # this reader's contract — a bad sidecar must not crash
+            # every fleet surface (rtop frames, /status, rwatch).
+            continue
+    return out
+
+
+def merge_fleet(snapshots, now=None, stale_s=FLEET_STALE_S):
+    """One fleet view over per-process snapshots (see
+    :func:`read_fleet`): per-process rows plus cross-process totals,
+    the chunk-rate skew spread, straggler processes (rate below
+    :data:`FLEET_STRAGGLER_FRAC` of the fleet median) and stale
+    processes (snapshot older than ``stale_s``)."""
+    now = time.time() if now is None else now
+    processes, rates = {}, {}
+    totals = {"chunks_done": 0, "chunks_parked": 0}
+    bound_counts = {}
+    for p in sorted(snapshots):
+        snap = snapshots[p]
+        ts = snap.get("ts")
+        age = None if ts is None else round(max(0.0, now - float(ts)), 3)
+        row = {
+            "chunks_done": int(snap.get("chunks_done") or 0),
+            "chunks_parked": int(snap.get("chunks_parked") or 0),
+            "chunk_in_flight": snap.get("chunk_in_flight"),
+            "running": bool(snap.get("running")),
+            "breaker": snap.get("breaker"),
+            "rate_chunks_per_s": snap.get("rate_chunks_per_s"),
+            "bound_counts": snap.get("bound_counts") or {},
+            "phases": snap.get("phases") or {},
+            "snapshot_age_s": age,
+            "last_incident": (snap.get("last_incident") or {}).get(
+                "incident") if snap.get("last_incident") else None,
+            "obs_write_errors": int(snap.get("counters", {}).get(
+                "obs_write_errors", 0)),
+        }
+        processes[str(p)] = row
+        totals["chunks_done"] += row["chunks_done"]
+        totals["chunks_parked"] += row["chunks_parked"]
+        for k, v in row["bound_counts"].items():
+            bound_counts[k] = bound_counts.get(k, 0) + int(v)
+        if row["rate_chunks_per_s"]:
+            rates[str(p)] = float(row["rate_chunks_per_s"])
+    out = {
+        "processes": processes,
+        "nprocesses": len(processes),
+        "chunks_done": totals["chunks_done"],
+        "chunks_parked": totals["chunks_parked"],
+        "bound_counts": bound_counts,
+        "stale": sorted(
+            p for p, row in processes.items()
+            if row["snapshot_age_s"] is not None
+            and row["running"] and row["snapshot_age_s"] > stale_s),
+        "stragglers": [],
+    }
+    if rates:
+        med = _median(list(rates.values()))
+        out["rate_chunks_per_s"] = round(sum(rates.values()), 4)
+        out["skew"] = {
+            "rate_min": round(min(rates.values()), 4),
+            "rate_median": round(med, 4),
+            "rate_max": round(max(rates.values()), 4),
+            "ratio": round(max(rates.values())
+                           / max(min(rates.values()), 1e-9), 2),
+        }
+        out["stragglers"] = sorted(
+            p for p, r in rates.items()
+            if med and r < FLEET_STRAGGLER_FRAC * med)
+    return out
+
+
+# ---------------------------------------------------------- alert snapshots
+
+# Recent-chunk window the live snapshot's straggler/tunnel signals are
+# computed over: a windowed signal RESOLVES once the offending chunks
+# age out, where a whole-run aggregate would latch forever.
+WATCH_WINDOW = 8
+
+
+def watch_snapshot(state, heartbeats=None, now=None, window=WATCH_WINDOW):
+    """The live signal vector the alert rules evaluate, derived from a
+    :class:`JournalFollower` poll ``state`` (plus the heartbeat
+    sidecars). This is the ONE derivation shared by the in-process
+    scheduler engine and the out-of-process ``tools/rwatch.py``
+    follower, so both fire on identical evidence.
+
+    Keys (None = signal not measurable yet):
+
+    * ``chunks_done`` / ``chunks_total`` / ``chunks_parked`` /
+      ``complete`` — progress;
+    * ``consecutive_tunnel`` — how many of the newest chunks, counting
+      back from the latest, were tunnel-bound;
+    * ``straggler_ratio`` — slowest/median chunk wall-clock over the
+      last ``window`` chunks;
+    * ``heartbeat_age_s`` — age of the FRESHEST heartbeat (a run is
+      stalled only when even its newest beat is old);
+    * ``obs_write_failures`` — count of ``obs_write_failed`` incidents
+      so far (a monotone series the growth rule differentiates);
+    * ``hbm_ratio_median`` — actual/predicted peak-HBM ratio over the
+      windowed chunks (model drift signal).
+    """
+    now = time.time() if now is None else now
+    header = state.get("header") or {}
+    chunks = state.get("chunks") or {}
+    total = header.get("chunks_total")
+    parked = state.get("parked") or {}
+    recent = [chunks[cid] for cid in sorted(chunks)][-int(window):]
+    walls, bounds, hbm_ratios = [], [], []
+    for rec in recent:
+        t = rec.get("timings") or {}
+        w = float(t.get("chunk_s", 0.0))
+        if w > 0:
+            walls.append(w)
+        bounds.append(t.get("bound"))
+        h = rec.get("hbm") or {}
+        if h.get("ratio") is not None:
+            hbm_ratios.append(float(h["ratio"]))
+    consecutive_tunnel = 0
+    for b in reversed(bounds):
+        if b != "tunnel":
+            break
+        consecutive_tunnel += 1
+    straggler_ratio = None
+    if len(walls) >= 2:
+        med = _median(walls)
+        if med:
+            straggler_ratio = round(max(walls) / med, 3)
+    beat_age = None
+    if heartbeats:
+        beat_age = round(max(0.0, now - max(heartbeats.values())), 3)
+    done = len(chunks)
+    return {
+        "now": now,
+        "survey_id": header.get("survey_id"),
+        "chunks_total": total,
+        "chunks_done": done,
+        "chunks_parked": len(parked),
+        "complete": (total is not None
+                     and done + len(parked) >= int(total)),
+        "consecutive_tunnel": consecutive_tunnel,
+        "straggler_ratio": straggler_ratio,
+        "heartbeat_age_s": beat_age,
+        "obs_write_failures": sum(
+            1 for inc in state.get("incidents") or ()
+            if inc.get("incident") == "obs_write_failed"),
+        "hbm_ratio_median": (round(_median(hbm_ratios), 4)
+                             if hbm_ratios else None),
+    }
 
 
 def parse_prom_text(text):
@@ -443,8 +637,16 @@ def build_report(journal_dir, trace_path=None, prom_path=None):
         "tunnel": tunnel_stats(chunks),
         "hbm": hbm_stats(chunks),
         "incidents": j["incidents"],
+        "alerts": j.get("alerts", []),
         "metrics": j["metrics"],
     }
+    fleet = read_fleet(journal_dir)
+    if fleet:
+        # Multi-process runs leave one fleet_<p>.json per process next
+        # to the journal; the merged view gives the report per-process
+        # attribution and the cross-process skew comparison. Journals
+        # without sidecars (every pre-fleet run) skip the section.
+        report["fleet"] = merge_fleet(fleet)
     if trace_path is None:
         cand = os.path.join(journal_dir, "trace.json")
         trace_path = cand if os.path.exists(cand) else None
@@ -525,6 +727,17 @@ def render_text(report):
                    if "span_id" in inc else "")
             add(f"  {inc.get('utc', '?'):<26} "
                 f"{inc.get('incident', '?')}{where}{sid}")
+    if report.get("alerts"):
+        add("")
+        add(f"alert timeline ({len(report['alerts'])}):")
+        for al in report["alerts"]:
+            add(f"  {al.get('utc', '?'):<26} {al.get('event', '?'):<9}"
+                f" {al.get('rule', '?')}"
+                + (f" (value {al.get('value')})"
+                   if al.get("value") is not None else ""))
+    if report.get("fleet"):
+        lines.append("")
+        lines.extend(render_fleet_text(report["fleet"]))
     if "trace" in report:
         tr = report["trace"]
         add("")
@@ -534,6 +747,56 @@ def render_text(report):
                if tr["dropped_events"] else "")
             + f" ({tr['path']})")
     return "\n".join(lines) + "\n"
+
+
+def render_fleet_text(fleet):
+    """The human lines of a merged fleet view (shared by rreport's
+    fleet section and ``rtop --fleet``): one row per process with its
+    progress, rate, phase split and skew/staleness highlighting."""
+    lines = [f"fleet ({fleet['nprocesses']} process(es)): "
+             f"{fleet['chunks_done']} done"
+             + (f", {fleet['chunks_parked']} parked"
+                if fleet.get("chunks_parked") else "")
+             + (f", {fleet['rate_chunks_per_s']} chunk/s aggregate"
+                if fleet.get("rate_chunks_per_s") is not None else "")]
+    skew = fleet.get("skew")
+    if skew:
+        lines.append(
+            f"  rate skew: min/median/max {skew['rate_min']}/"
+            f"{skew['rate_median']}/{skew['rate_max']} chunk/s "
+            f"(spread {skew['ratio']}x)")
+    for p, row in sorted(fleet["processes"].items(),
+                         key=lambda kv: int(kv[0])):
+        marks = []
+        if p in fleet.get("stragglers", ()):
+            marks.append("STRAGGLER")
+        if p in fleet.get("stale", ()):
+            marks.append("STALE")
+        if row.get("breaker") == "open":
+            marks.append("BREAKER-OPEN")
+        if row.get("obs_write_errors"):
+            marks.append(f"obs_write_errors={row['obs_write_errors']}")
+        phases = row.get("phases") or {}
+        serial = sum(float(phases.get(k, 0.0)) for k in SERIAL_PHASES)
+        phase_txt = ""
+        if serial > 0:
+            phase_txt = "  " + " ".join(
+                f"{k[:-2]} {100 * float(phases.get(k, 0.0)) / serial:.0f}%"
+                for k in SERIAL_PHASES)
+        line = (f"  p{p}: {row['chunks_done']} done"
+                + (f" (+{row['chunks_parked']} parked)"
+                   if row.get("chunks_parked") else "")
+                + (f", in-flight {row['chunk_in_flight']}"
+                   if row.get("chunk_in_flight") is not None else "")
+                + (f", {row['rate_chunks_per_s']} chunk/s"
+                   if row.get("rate_chunks_per_s") is not None else "")
+                + (f", snapshot {row['snapshot_age_s']}s old"
+                   if row.get("snapshot_age_s") is not None else "")
+                + phase_txt)
+        if marks:
+            line += "  [" + ", ".join(marks) + "]"
+        lines.append(line)
+    return lines
 
 
 # ------------------------------------------------------------- comparison

@@ -231,4 +231,39 @@ def run_search_multihost(plan, batch_local, tobs, dms_local=None,
         export_run_trace(journal.directory,
                          process_index=jax.process_index(),
                          process_count=jax.process_count())
+        # ... and its own fleet snapshot sidecar (fleet_<p>.json): the
+        # per-process status any reader — the /status fleet block,
+        # rreport, rtop --fleet, rwatch — merges into the one fleet
+        # view of the run. Never fatal, like every obs write.
+        from ..obs import fleet
+
+        if fleet.enabled():
+            # This layer is called once per chunk with sequential ids,
+            # so chunk_id + 1 is the chunks THIS process has searched
+            # (the writer-only chunks_done counter undercounts on
+            # non-writer peers). `running` derives from the journal
+            # header's total where one exists: the final chunk's
+            # snapshot must read running=false, or every COMPLETED
+            # multihost run would look stale/hung to the fleet view
+            # two minutes later. The whole publication is guarded like
+            # the scheduler's _fleet_safe: snapshot assembly (incl.
+            # the header read off shared storage) is observability and
+            # must never kill the survey it describes.
+            try:
+                done = int(chunk_id) + 1
+                hdr = journal._header() or {}
+                total = hdr.get("chunks_total")
+                fleet.write_snapshot(journal.directory, fleet.snapshot(
+                    jax.process_index(),
+                    status={
+                        "survey_id": hdr.get("survey_id"),
+                        "running": (True if total is None
+                                    else done < int(total)),
+                        "chunks_done": done,
+                        "last_incident": incidents.last_incident(),
+                    },
+                    metrics=get_metrics(),
+                ))
+            except Exception as err:
+                log.warning("fleet snapshot failed: %s", err)
     return peaks, polycos

@@ -55,8 +55,10 @@ INCIDENT_KINDS = (
     "peer_loss",          # multihost: degraded to local-only mode
     "storage_recovered",  # journal/fsio: torn tail truncated or healed
     "record_corrupt",     # journal: checksum-failed record(s) dropped
-    "obs_write_failed",   # ledger/trace/prom/heartbeat write degraded
+    "obs_write_failed",   # ledger/trace/prom/heartbeat/fleet write degraded
     "cache_corrupt",      # exec cache: corrupt entry evicted + rebuilt
+    "alert_fired",        # obs.alerts: a rule started firing
+    "alert_resolved",     # obs.alerts: a firing rule cleared
 )
 
 _lock = threading.Lock()

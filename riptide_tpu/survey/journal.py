@@ -166,6 +166,7 @@ class SurveyJournal:
         self.journal_path = os.path.join(self.directory, "journal.jsonl")
         self.peaks_path = os.path.join(self.directory, "peaks.jsonl")
         self._peak_rows = None  # lazily loaded peak-store line count
+        self._header_cache = None  # immutable once written (see _header)
         self._recovered = False
 
     # -- crash recovery -----------------------------------------------------
@@ -331,6 +332,17 @@ class SurveyJournal:
         rec.setdefault("utc", _utc_iso())
         _append_line(self.journal_path, rec, site="journal_append")
 
+    def record_alert(self, record):
+        """Append one ``alert`` record (built by
+        :meth:`riptide_tpu.obs.alerts.AlertEngine._event` — a rule
+        firing or resolving). Like incidents, purely additive: every
+        other reader filters by ``kind``, so pre-alert journals and
+        readers interoperate both ways."""
+        rec = dict(record)
+        rec.setdefault("kind", "alert")
+        rec.setdefault("utc", _utc_iso())
+        _append_line(self.journal_path, rec, site="journal_append")
+
     def heartbeat(self, process_index, ts=None):
         """Append one liveness beat to THIS process's sidecar
         (``heartbeat_<p>.jsonl``). Sidecars are single-writer by
@@ -356,8 +368,17 @@ class SurveyJournal:
         return _read_lines(self.journal_path)
 
     def _header(self):
+        """The journal's header record, or None. A header is written
+        once and never changes, so a non-None result is cached — the
+        per-chunk readers (fleet publication, survey_id lookups) must
+        not re-read the whole append-only journal every chunk. A None
+        result is deliberately NOT cached: write_header's idempotence
+        check runs before the header exists."""
+        if self._header_cache is not None:
+            return self._header_cache
         for rec in self._records():
             if rec.get("kind") == "header":
+                self._header_cache = rec
                 return rec
         return None
 

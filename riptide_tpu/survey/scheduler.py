@@ -48,7 +48,9 @@ import random
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-from ..obs import prom
+from ..obs import fleet, prom
+from ..obs import report as obs_report
+from ..obs.alerts import AlertEngine, install_engine, rules_from_spec
 from ..obs.chrome import export_run_trace
 from ..obs.schema import chunk_timing
 from ..obs.trace import span
@@ -314,11 +316,27 @@ class SurveyScheduler:
     monitor : PeerLivenessMonitor or None
         When given, a heartbeat is appended to this process's journal
         sidecar as each chunk starts (multi-host peer-loss detection).
+    process_index : int
+        This process's index within a multi-process run: names the
+        fleet snapshot sidecar (``fleet_<p>.json``) and offsets the
+        Prometheus endpoint port (``RIPTIDE_PROM_PORT_OFFSET``).
+    fleet_dir : str or None
+        Directory the fleet snapshot sidecar is written to (default:
+        the journal directory). A process whose journal lives
+        elsewhere — e.g. a per-process shard journal — can still
+        federate into a shared run directory by pointing this there.
+    alerts : AlertEngine or None
+        Rule engine evaluated over the live run after every chunk
+        (fire/resolve -> ``alert`` journal record + ``alert_fired`` /
+        ``alert_resolved`` incident + prom gauge). Default: built from
+        ``RIPTIDE_ALERT_RULES`` when ``RIPTIDE_ALERTS`` is on and the
+        run is journaled.
     """
 
     def __init__(self, searcher, chunks, journal=None, resume=False,
                  retry=None, faults=None, survey_id=None, metrics=None,
-                 watchdog=None, breaker=None, monitor=None):
+                 watchdog=None, breaker=None, monitor=None,
+                 process_index=0, fleet_dir=None, alerts=None):
         self.searcher = searcher
         self.chunks = [list(c) for c in chunks]
         self.journal = journal
@@ -331,6 +349,9 @@ class SurveyScheduler:
         if breaker is not None and breaker.metrics is None:
             breaker.metrics = self.metrics
         self.monitor = monitor
+        self.process_index = int(process_index)
+        self.fleet_dir = fleet_dir
+        self.alerts = alerts
         if survey_id is None:
             survey_id = survey_identity([f for c in self.chunks for f in c])
         self.survey_id = survey_id
@@ -343,6 +364,10 @@ class SurveyScheduler:
         self._run_timings = []
         self._replayed_timings = []
         self._running = False
+        # Incremental reader over this run's OWN journal, feeding the
+        # alert engine the same watch_snapshot rwatch derives from
+        # another process (None while alerting is off).
+        self._follower = None
 
     # -- staging ------------------------------------------------------------
 
@@ -497,15 +522,94 @@ class SurveyScheduler:
             incidents.emit("obs_write_failed", op="heartbeat",
                            error=str(err))
 
+    # -- fleet + alerts -----------------------------------------------------
+
+    def _fleet_directory(self):
+        """Where this process's ``fleet_<p>.json`` sidecar lives (None
+        disables fleet writes: no journal and no explicit fleet_dir
+        means there is no run directory to federate under)."""
+        if self.fleet_dir is not None:
+            return self.fleet_dir
+        return self.journal.directory if self.journal is not None else None
+
+    def _fleet_safe(self):
+        """(Re)write this process's fleet snapshot sidecar — the
+        per-chunk publication any reader merges into the fleet view.
+        write_snapshot already degrades failures to an incident +
+        counter; the extra guard keeps snapshot ASSEMBLY bugs from
+        ever becoming scheduling failures (obs must not kill the run
+        it observes)."""
+        directory = self._fleet_directory()
+        if directory is None or not fleet.enabled():
+            return
+        try:
+            fleet.write_snapshot(directory, fleet.snapshot(
+                self.process_index, status=self.status(include_fleet=False),
+                metrics=self.metrics, timings=self._run_timings))
+        except Exception as err:
+            log.warning("fleet snapshot failed: %s", err)
+
+    def _build_alerts(self):
+        """The run's alert engine: the constructor-injected one, else
+        built from ``RIPTIDE_ALERT_RULES`` when ``RIPTIDE_ALERTS`` is
+        on and the run is journaled (the follower-based snapshot needs
+        a journal to follow). Returns None when alerting is off."""
+        if self.alerts is not None:
+            return self.alerts
+        if self.journal is None or not envflags.get("RIPTIDE_ALERTS"):
+            return None
+        try:
+            rules = rules_from_spec(envflags.get("RIPTIDE_ALERT_RULES"))
+        except ValueError as err:
+            raise ValueError(
+                f"bad RIPTIDE_ALERT_RULES: {err}") from err
+        return AlertEngine(rules)
+
+    def _alert_event(self, event):
+        """Engine fire/resolve hook: journal the ``alert`` record and
+        mirror it as a structured incident (which the installed sink
+        also journals, next to the chunk records)."""
+        if self.journal is not None:
+            try:
+                self.journal.record_alert(event)
+            except OSError as err:
+                log.warning("alert record append failed: %s", err)
+                self.metrics.add("obs_write_errors")
+                incidents.emit("obs_write_failed", op="alert",
+                               error=str(err))
+        incidents.emit("alert_" + str(event.get("event")),
+                       rule=event.get("rule"), value=event.get("value"),
+                       limit=event.get("limit"))
+
+    def _alerts_safe(self):
+        """Evaluate the alert rules over the live run: poll this run's
+        own journal through the SAME follower/snapshot derivation
+        rwatch applies from another process, so in-process and
+        out-of-process watchers fire on identical evidence. Never
+        fatal — a broken rule must not take down the survey."""
+        if self.alerts is None or self._follower is None:
+            return
+        try:
+            state = self._follower.poll()
+            beats = (self.journal.read_heartbeats()
+                     if self.journal is not None else {})
+            self.alerts.evaluate(
+                obs_report.watch_snapshot(state, heartbeats=beats))
+        except Exception as err:
+            log.warning("alert evaluation failed: %s", err)
+
     # -- live status --------------------------------------------------------
 
-    def status(self):
+    def status(self, include_fleet=True):
         """The live ``/status`` document of this survey (registered
         with :func:`riptide_tpu.obs.prom.set_status_provider` while
         ``RIPTIDE_STATUS`` is on, and the same numbers ``tools/rtop.py``
         derives by tail-reading the journal): chunk progress, the EWMA
-        chunk rate and ETA, heartbeat ages, breaker state and the most
-        recent incident."""
+        chunk rate and ETA, heartbeat ages, breaker state, the most
+        recent incident, the active-alert map, and — when fleet
+        sidecars exist — the merged cross-process ``fleet`` block
+        (``include_fleet=False`` skips the merge: the fleet snapshot
+        writer itself must not recurse into it)."""
         m = self.metrics
         done = int(m.counter("chunks_done") + m.counter("chunks_skipped"))
         parked = int(m.counter("chunks_parked"))
@@ -536,12 +640,21 @@ class SurveyScheduler:
                         if self.breaker is not None else None),
             "last_incident": incidents.last_incident(),
         }
+        if self.alerts is not None:
+            status["alerts"] = self.alerts.active()
         if self.journal is not None:
             now = time.time()
             status["heartbeat_age_s"] = {
                 str(p): round(max(0.0, now - ts), 3)
                 for p, ts in self.journal.read_heartbeats().items()
             }
+        directory = self._fleet_directory()
+        if include_fleet and directory is not None:
+            snapshots = obs_report.read_fleet(directory)
+            if snapshots:
+                # One merged cross-process view on ANY member's
+                # /status: the sidecars federate the whole run.
+                status["fleet"] = obs_report.merge_fleet(snapshots)
         return status
 
     # -- main loop ----------------------------------------------------------
@@ -558,6 +671,11 @@ class SurveyScheduler:
         :meth:`status` is registered as the live ``/status`` source on
         the Prometheus endpoint (the provider stays registered after
         the run, so a final state remains queryable)."""
+        # Build (and so VALIDATE) the alert engine before any
+        # process-wide hook is installed: a typo'd RIPTIDE_ALERT_RULES
+        # must fail this run without leaking the incident sink or the
+        # storage-fault hook to whatever runs next in the process.
+        self.alerts = self._build_alerts()
         prev_sink = None
         sink_set = False
         # A fresh run's /status must not inherit the previous run's
@@ -572,12 +690,32 @@ class SurveyScheduler:
         prev_hook = fsio.set_storage_faults(self.faults.storage_op)
         if envflags.get("RIPTIDE_STATUS"):
             prom.set_status_provider(self.status)
+        # Alert engine + fleet plumbing for the run's duration: the
+        # engine is installed process-wide so the Prometheus page can
+        # render riptide_alert_active{rule=...}; the fleet source lets
+        # /metrics federate every process's sidecar under a `process`
+        # label. Both stay registered after the run (like the status
+        # provider) so the final state remains queryable; the NEXT run
+        # re-points them.
+        if self.alerts is not None:
+            self.alerts.on_event = self._alert_event
+            install_engine(self.alerts)
+            if self.journal is not None:
+                self._follower = obs_report.JournalFollower(
+                    self.journal.directory)
+        fleet_directory = self._fleet_directory()
+        if fleet_directory is not None and fleet.enabled():
+            prom.set_fleet_source(
+                lambda: obs_report.read_fleet(fleet_directory))
         self._running = True
         try:
             return self._run()
         finally:
             self._running = False
             self._in_flight = None
+            # Final sidecar: the at-rest record of this process
+            # (running=false, final counters) for late readers.
+            self._fleet_safe()
             fsio.set_storage_faults(prev_hook)
             if sink_set:
                 incidents.set_sink(prev_sink)
@@ -617,8 +755,10 @@ class SurveyScheduler:
         peaks_by_chunk = dict(done)
         # Exposition hooks: a scraper polls the RUNNING survey via the
         # optional localhost endpoint (RIPTIDE_PROM_PORT); both calls
-        # are single flag reads when the operator left them off.
-        prom.maybe_serve(self.metrics)
+        # are single flag reads when the operator left them off. The
+        # port is offset by this process's index so co-hosted
+        # processes each get their own endpoint.
+        prom.maybe_serve(self.metrics, process_index=self.process_index)
         with ThreadPoolExecutor(max_workers=1) as stager, \
                 ThreadPoolExecutor(max_workers=self.searcher.io_threads) \
                 as loaders:
@@ -636,6 +776,8 @@ class SurveyScheduler:
                 self._heartbeat_safe()
                 if self.breaker is not None and not self.breaker.allow():
                     self._park(cid, f"circuit {self.breaker.state}")
+                    self._fleet_safe()
+                    self._alerts_safe()
                     continue
                 self._in_flight = cid
                 t0 = time.perf_counter()
@@ -653,6 +795,8 @@ class SurveyScheduler:
                     # retries parks instead of aborting the survey.
                     self.breaker.record_failure()
                     self._park(cid, f"dispatch failed after retries: {err}")
+                    self._fleet_safe()
+                    self._alerts_safe()
                     continue
                 finally:
                     self._in_flight = None
@@ -684,9 +828,18 @@ class SurveyScheduler:
                             timings=timing, attempts=attempts, dq=dq,
                             hbm=hbm,
                         )
+                # Per-chunk fleet publication + live alert evaluation
+                # (both no-ops while their flags are off, both
+                # never-fatal): the measure→detect half of the loop.
+                self._fleet_safe()
+                self._alerts_safe()
                 log.debug("chunk %d/%d done: %d peaks, %d attempt(s)",
                           cid + 1, len(self.chunks), len(peaks), attempts)
         self.metrics.set_gauge("queue_depth", 0)
+        # One closing evaluation over the final journal state, so a
+        # condition that cleared on the last chunk still resolves
+        # before the run's engine goes quiescent.
+        self._alerts_safe()
         if self.journal is not None:
             self.journal.record_metrics(self.metrics.summary())
             # One Perfetto-loadable trace file per run, next to the

@@ -190,6 +190,16 @@ _ALL = [
         since="PR 8 (0.8.0)",
     ),
     EnvFlag(
+        "RIPTIDE_PROM_PORT_OFFSET", "bool", True,
+        "Offset the Prometheus endpoint port by this process's "
+        "distributed index (port = RIPTIDE_PROM_PORT + "
+        "jax.process_index()), so multiple processes on one host get "
+        "deterministic per-process endpoints instead of racing to "
+        "bind the same port (the loser silently lost its endpoint). "
+        "`0` binds the literal port in every process.",
+        since="PR 14 (0.13.0)",
+    ),
+    EnvFlag(
         "RIPTIDE_PROM_TEXTFILE", "str", None,
         "Path of a Prometheus textfile (node_exporter textfile-"
         "collector format) the survey layers write the metrics "
@@ -220,6 +230,37 @@ _ALL = [
         "reports 503: a survey process whose freshest journal "
         "heartbeat is older than this is up but not making progress.",
         since="PR 9 (0.9.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_FLEET", "bool", True,
+        "Write the per-process fleet status sidecar (`fleet_<p>.json`, "
+        "atomically rewritten next to the journal after every chunk) "
+        "that /status, rreport, `rtop --fleet` and rwatch merge into "
+        "the cross-process fleet view. Writes are never fatal "
+        "(ENOSPC degrades to an incident). `0` disables the sidecar.",
+        since="PR 14 (0.13.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_ALERTS", "bool", False,
+        "Evaluate the alert-rule engine (riptide_tpu/obs/alerts.py) "
+        "over the live run after every chunk of a journaled survey: "
+        "firing/resolving journals an `alert` record, emits "
+        "alert_fired/alert_resolved incidents and flips the "
+        "riptide_alert_active{rule=...} Prometheus gauge. Off by "
+        "default (tools/rwatch.py can watch any run from outside "
+        "without it).",
+        since="PR 14 (0.13.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_ALERT_RULES", "str", None,
+        "Alert rule spec for the in-scheduler engine: comma-separated "
+        "`name[:limit[:for_count]]` entries naming builtin rules "
+        "(tunnel_bound, heartbeat_stale, parked_chunks, "
+        "straggler_ratio, obs_write_errors, hbm_drift), or `default` "
+        "for the full catalog with stock thresholds. Unset = the full "
+        "catalog. Unknown names fail the run at start (a typo'd rule "
+        "must not silently never fire).",
+        since="PR 14 (0.13.0)",
     ),
     EnvFlag(
         "RIPTIDE_HBM_BUDGET", "int", 0,

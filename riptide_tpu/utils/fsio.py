@@ -70,6 +70,7 @@ SITES = (
     "trace_export",       # Chrome trace-event JSON exports
     "prom_textfile",      # Prometheus textfile page
     "exec_cache_store",   # compiled-executable cache entries
+    "fleet_snapshot",     # fleet_<p>.json per-process status sidecars
 )
 
 _HEX = frozenset(b"0123456789abcdef")

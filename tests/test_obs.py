@@ -40,6 +40,23 @@ def tracer():
     set_tracer(prev)
 
 
+@pytest.fixture(autouse=True)
+def _no_leaked_fleet_or_engine():
+    """Fleet source and alert engine are process-wide (earlier suite
+    files run real schedulers, which by design leave theirs
+    registered); clear both sides so the exposition tests here see
+    only what they install."""
+    from riptide_tpu.obs import alerts
+
+    def _clear():
+        prom.set_fleet_source(None)
+        alerts.install_engine(None)
+
+    _clear()
+    yield
+    _clear()
+
+
 # ------------------------------------------------------------- tracer
 
 def test_span_records_nests_and_inherits_chunk(tracer):

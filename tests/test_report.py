@@ -53,13 +53,18 @@ def tracer():
 
 @pytest.fixture(autouse=True)
 def _no_leaked_sinks_or_providers():
-    """Incident sink, status provider and last-incident are
-    process-wide; clear them on BOTH sides of every test here (earlier
-    suite files run real schedulers, which by design leave their status
-    provider registered)."""
+    """Incident sink, status provider, last-incident, fleet source and
+    alert engine are process-wide; clear them on BOTH sides of every
+    test here (earlier suite files run real schedulers, which by
+    design leave their status provider / fleet source / engine
+    registered)."""
+    from riptide_tpu.obs import alerts
+
     def _clear():
         incidents.set_sink(None)
         prom.set_status_provider(None)
+        prom.set_fleet_source(None)
+        alerts.install_engine(None)
         incidents.clear_last()
 
     _clear()

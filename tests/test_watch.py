@@ -1,0 +1,666 @@
+"""
+Fleet observability plane tests (PR 14): the alert-rule engine (every
+mode, hysteresis/flap behaviour, spec parsing), the watch-snapshot
+derivation, fleet snapshot write/read/merge (including the
+never-fatal-under-ENOSPC invariant), journal ``alert`` records and
+follower interop, Prometheus fleet federation + the alert gauge, the
+``maybe_serve`` per-process port offset, the rwatch CLI exit codes,
+one small in-scheduler e2e, and backward compat (pre-PR-14 journals —
+no fleet sidecars, no alert records — render/resume unchanged).
+
+The heavier acceptance path (two real processes federating one run
+directory, rwatch following live, the ENOSPC control-vs-fault
+byte-identity) lives in tools/watch_demo.py (`make watch-demo`).
+"""
+import json
+import os
+import sys
+
+import pytest
+
+from riptide_tpu.obs import alerts, fleet, prom
+from riptide_tpu.obs import report as rep
+from riptide_tpu.survey import incidents
+from riptide_tpu.survey.faults import FaultPlan
+from riptide_tpu.survey.journal import SurveyJournal, _append_line
+from riptide_tpu.survey.metrics import MetricsRegistry, get_metrics
+from riptide_tpu.utils import fsio
+
+from synth import generate_data_presto
+
+TOOLS = os.path.normpath(
+    os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def _tool(name):
+    if TOOLS not in sys.path:
+        sys.path.insert(0, TOOLS)
+    return __import__(name)
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    """Alert engine, fleet source, incident sink/last and status
+    provider are process-wide; clear them on both sides of every test
+    (earlier suite files run real schedulers, which deliberately leave
+    their hooks registered)."""
+    def _clear():
+        alerts.install_engine(None)
+        prom.set_fleet_source(None)
+        prom.set_status_provider(None)
+        incidents.set_sink(None)
+        incidents.clear_last()
+        fsio.set_storage_faults(None)
+
+    _clear()
+    yield
+    _clear()
+
+
+# ------------------------------------------------------------ alert rules
+
+def test_threshold_rule_fires_and_resolves():
+    eng = alerts.AlertEngine([alerts.AlertRule(
+        "r", "x", 5.0, op=">=")])
+    assert eng.evaluate({"now": 1.0, "x": 1.0}) == []
+    ev = eng.evaluate({"now": 2.0, "x": 7.0})
+    assert [(e["event"], e["rule"]) for e in ev] == [("fired", "r")]
+    assert ev[0]["kind"] == "alert" and ev[0]["value"] == 7.0
+    assert eng.active() == {"r": True} and eng.unresolved() == ["r"]
+    # Still breaching: no duplicate fire.
+    assert eng.evaluate({"now": 3.0, "x": 9.0}) == []
+    ev = eng.evaluate({"now": 4.0, "x": 1.0})
+    assert [(e["event"],) for e in ev] == [("resolved",)]
+    assert eng.unresolved() == []
+    assert [e["event"] for e in eng.events()] == ["fired", "resolved"]
+
+
+def test_consecutive_count_suppresses_flap():
+    """for_count=2: a value flapping across the limit every evaluation
+    never fires; two consecutive breaches do. clear_count=2 demands
+    two clean evaluations before resolving."""
+    eng = alerts.AlertEngine([alerts.AlertRule(
+        "r", "x", 5.0, for_count=2, clear_count=2)])
+    for i, x in enumerate([9, 1, 9, 1, 9, 1] * 2):
+        assert eng.evaluate({"now": float(i), "x": x}) == [], \
+            f"flapping input fired at step {i}"
+    ev = eng.evaluate({"now": 20.0, "x": 9})
+    assert ev == []  # first consecutive breach
+    ev = eng.evaluate({"now": 21.0, "x": 9})
+    assert [e["event"] for e in ev] == ["fired"]
+    assert eng.evaluate({"now": 22.0, "x": 1}) == []  # first clean
+    ev = eng.evaluate({"now": 23.0, "x": 1})
+    assert [e["event"] for e in ev] == ["resolved"]
+
+
+def test_absence_rule_missing_and_stale():
+    missing = alerts.AlertRule("m", "age", 10.0, op=">", mode="absence",
+                               missing_fires=True)
+    tolerant = alerts.AlertRule("t", "age", 10.0, op=">",
+                                mode="absence")
+    eng = alerts.AlertEngine([missing, tolerant])
+    ev = eng.evaluate({"now": 1.0})  # no signal at all
+    assert [(e["rule"], e["event"]) for e in ev] == [("m", "fired")]
+    ev = eng.evaluate({"now": 2.0, "age": 3.0})  # fresh again
+    assert [(e["rule"], e["event"]) for e in ev] == [("m", "resolved")]
+    ev = eng.evaluate({"now": 3.0, "age": 99.0})  # stale
+    assert sorted((e["rule"], e["event"]) for e in ev) == \
+        [("m", "fired"), ("t", "fired")]
+
+
+def test_rate_rule_growth_then_quiet_window():
+    eng = alerts.AlertEngine([alerts.AlertRule(
+        "r", "errors", 1, op=">=", mode="rate", window_s=10.0)])
+    assert eng.evaluate({"now": 0.0, "errors": 0}) == []
+    assert eng.evaluate({"now": 1.0, "errors": 0}) == []
+    ev = eng.evaluate({"now": 2.0, "errors": 1})  # grew within window
+    assert [e["event"] for e in ev] == ["fired"]
+    assert ev[0]["value"] == 1.0  # the growth, not the level
+    # Same level, but the growth sample is still inside the window.
+    assert eng.evaluate({"now": 5.0, "errors": 1}) == []
+    # Window slides past the growth: resolves at the old LEVEL.
+    ev = eng.evaluate({"now": 13.0, "errors": 1})
+    assert [e["event"] for e in ev] == ["resolved"]
+
+
+def test_transform_rule_hbm_drift_two_sided():
+    [rule] = [r for r in alerts.default_rules() if r.name == "hbm_drift"]
+    eng = alerts.AlertEngine([rule])
+    assert eng.evaluate({"now": 1.0, "hbm_ratio_median": 1.2}) == []
+    ev = eng.evaluate({"now": 2.0, "hbm_ratio_median": 0.3})  # |0.3-1|>.5
+    assert [e["event"] for e in ev] == ["fired"]
+    ev = eng.evaluate({"now": 3.0, "hbm_ratio_median": 1.1})
+    assert [e["event"] for e in ev] == ["resolved"]
+    ev = eng.evaluate({"now": 4.0, "hbm_ratio_median": 1.8})
+    assert [e["event"] for e in ev] == ["fired"]
+
+
+def test_rules_from_spec():
+    assert [r.name for r in alerts.rules_from_spec(None)] == \
+        [r.name for r in alerts.default_rules()]
+    assert [r.name for r in alerts.rules_from_spec("default")] == \
+        [r.name for r in alerts.default_rules()]
+    rules = alerts.rules_from_spec("straggler_ratio:2.5:3,parked_chunks")
+    assert [r.name for r in rules] == ["straggler_ratio",
+                                      "parked_chunks"]
+    assert rules[0].limit == 2.5 and rules[0].for_count == 3
+    assert rules[1].limit == 1.0  # builtin default kept
+    # `default` plus a retune: full catalog, overridden entry.
+    rules = alerts.rules_from_spec("default,heartbeat_stale:30")
+    assert len(rules) == len(alerts.default_rules())
+    [hb] = [r for r in rules if r.name == "heartbeat_stale"]
+    assert hb.limit == 30.0 and hb.mode == "absence"
+    with pytest.raises(ValueError, match="unknown alert rule"):
+        alerts.rules_from_spec("no_such_rule:1")
+    with pytest.raises(ValueError, match="expected"):
+        alerts.rules_from_spec("parked_chunks:1:2:3")
+
+
+def test_on_event_hook_failure_is_swallowed():
+    def boom(event):
+        raise RuntimeError("sink down")
+
+    eng = alerts.AlertEngine(
+        [alerts.AlertRule("r", "x", 1.0)], on_event=boom)
+    ev = eng.evaluate({"now": 1.0, "x": 5.0})
+    assert [e["event"] for e in ev] == ["fired"]  # not raised
+    assert eng.active() == {"r": True}
+
+
+# --------------------------------------------------------- watch_snapshot
+
+def _chunk_rec(cid, chunk_s, bound="device", ratio=None):
+    rec = {"kind": "chunk", "chunk_id": cid,
+           "timings": {"chunk_s": chunk_s, "bound": bound}}
+    if ratio is not None:
+        rec["hbm"] = {"ratio": ratio}
+    return rec
+
+
+def test_watch_snapshot_signals():
+    state = {
+        "header": {"survey_id": "s", "chunks_total": 6},
+        "chunks": {i: _chunk_rec(i, 1.0 if i != 1 else 8.0,
+                                 bound="tunnel" if i >= 4 else "device",
+                                 ratio=1.1)
+                   for i in range(5)},
+        "parked": {5: {"kind": "parked"}},
+        "incidents": [{"incident": "obs_write_failed"},
+                      {"incident": "breaker_open"},
+                      {"incident": "obs_write_failed"}],
+    }
+    snap = rep.watch_snapshot(state, heartbeats={0: 90.0, 1: 100.0},
+                              now=103.0)
+    assert snap["chunks_done"] == 5 and snap["chunks_parked"] == 1
+    assert snap["complete"] is True  # 5 done + 1 parked == 6 total
+    assert snap["consecutive_tunnel"] == 1  # chunk 4 only (3 is device)
+    assert snap["straggler_ratio"] == 8.0  # 8.0 over median 1.0
+    assert snap["heartbeat_age_s"] == 3.0  # freshest beat (p1)
+    assert snap["obs_write_failures"] == 2
+    assert snap["hbm_ratio_median"] == 1.1
+
+    # Windowing: the chunk-1 straggler ages out of a 3-chunk window
+    # (chunks 2-4 are all healthy), so the signal can RESOLVE.
+    snap = rep.watch_snapshot(state, window=3, now=103.0)
+    assert snap["straggler_ratio"] == 1.0
+    assert snap["consecutive_tunnel"] == 1
+
+    # Empty directory state: nothing measurable, nothing crashes.
+    snap = rep.watch_snapshot({"chunks": {}}, now=1.0)
+    assert snap["complete"] is False
+    assert snap["straggler_ratio"] is None
+    assert snap["heartbeat_age_s"] is None
+
+
+# ------------------------------------------------------------------ fleet
+
+def test_fleet_snapshot_roundtrip_merge_and_skew(tmp_path):
+    reg = MetricsRegistry()
+    reg.add("obs_write_errors", 2)
+    timings = [{"chunk_s": 1.0, "wire_s": 0.2, "queue_s": 0.1,
+                "collect_s": 0.5, "host_s": 0.2, "bound": "device"},
+               {"chunk_s": 1.2, "wire_s": 0.9, "queue_s": 0.1,
+                "collect_s": 0.1, "host_s": 0.1, "bound": "tunnel"}]
+    s0 = fleet.snapshot(0, status={"survey_id": "s", "running": True,
+                                   "chunks_done": 2,
+                                   "rate_chunks_per_s": 1.0},
+                        metrics=reg, timings=timings, ts=1000.0)
+    s1 = fleet.snapshot(1, status={"survey_id": "s", "running": True,
+                                   "chunks_done": 1, "chunks_parked": 1,
+                                   "rate_chunks_per_s": 0.2},
+                        ts=1000.0)
+    assert fleet.write_snapshot(str(tmp_path), s0)
+    assert fleet.write_snapshot(str(tmp_path), s1)
+    assert sorted(os.listdir(tmp_path)) == ["fleet_0000.json",
+                                            "fleet_0001.json"]
+
+    snapshots = rep.read_fleet(str(tmp_path))
+    assert sorted(snapshots) == [0, 1]
+    merged = rep.merge_fleet(snapshots, now=1001.0)
+    assert merged["nprocesses"] == 2
+    assert merged["chunks_done"] == 3 and merged["chunks_parked"] == 1
+    assert merged["bound_counts"] == {"device": 1, "tunnel": 1}
+    assert merged["skew"]["rate_max"] == 1.0
+    assert merged["stragglers"] == ["1"]  # 0.2 < 0.5 x median(0.6)
+    assert merged["stale"] == []
+    p0 = merged["processes"]["0"]
+    assert p0["obs_write_errors"] == 2
+    assert p0["phases"]["wire_s"] == pytest.approx(1.1)
+    assert p0["snapshot_age_s"] == pytest.approx(1.0)
+
+    # The human rows render with the skew highlighting.
+    lines = rep.render_fleet_text(merged)
+    joined = "\n".join(lines)
+    assert "STRAGGLER" in joined and "p1:" in joined
+
+    # Staleness marking, and the re-write discipline (sidecars are
+    # whole-file replaces: the newest snapshot wins outright). p0's
+    # rewrite heals ITS staleness (and running=false exempts it
+    # regardless — a finished process's aging snapshot is not a
+    # stall); p1 never rewrote, so it stays stale.
+    merged = rep.merge_fleet(snapshots, now=1500.0, stale_s=120.0)
+    assert merged["stale"] == ["0", "1"]
+    s0b = fleet.snapshot(0, status={"running": False, "chunks_done": 4},
+                         ts=2000.0)
+    fleet.write_snapshot(str(tmp_path), s0b)
+    snapshots = rep.read_fleet(str(tmp_path))
+    assert snapshots[0]["chunks_done"] == 4
+    assert rep.merge_fleet(snapshots, now=2001.0)["stale"] == ["1"]
+
+
+def test_fleet_write_never_fatal_under_enospc(tmp_path):
+    plan = FaultPlan.parse("enospc:fleet_snapshot")
+    prev = fsio.set_storage_faults(plan.storage_op)
+    seen = []
+    incidents.set_sink(seen.append)
+    before = get_metrics().counter("obs_write_errors")
+    try:
+        out = fleet.write_snapshot(
+            str(tmp_path), fleet.snapshot(0, status={"running": True}))
+    finally:
+        fsio.set_storage_faults(prev)
+    assert out is None  # degraded, not raised
+    assert get_metrics().counter("obs_write_errors") == before + 1
+    assert [r["incident"] for r in seen] == ["obs_write_failed"]
+    assert seen[0]["detail"]["op"] == "fleet_snapshot"
+    assert not os.listdir(tmp_path)
+    # The hook cleared: the next write lands.
+    assert fleet.write_snapshot(
+        str(tmp_path), fleet.snapshot(0, status={"running": True}))
+
+
+def test_fleet_disabled_by_flag(monkeypatch):
+    monkeypatch.setenv("RIPTIDE_FLEET", "0")
+    assert not fleet.enabled()
+    monkeypatch.delenv("RIPTIDE_FLEET")
+    assert fleet.enabled()
+
+
+# ----------------------------------------------- journal alert records
+
+def test_record_alert_roundtrip_and_reader_interop(tmp_path):
+    j = SurveyJournal(str(tmp_path / "j"))
+    j.write_header("s", 1)
+    eng = alerts.AlertEngine(
+        [alerts.AlertRule("parked_chunks", "chunks_parked", 1)],
+        on_event=j.record_alert)
+    eng.evaluate({"now": 1.0, "chunks_parked": 2})
+    eng.evaluate({"now": 2.0, "chunks_parked": 0})
+
+    state = rep.read_journal(str(tmp_path / "j"))
+    assert [(a["event"], a["rule"]) for a in state["alerts"]] == \
+        [("fired", "parked_chunks"), ("resolved", "parked_chunks")]
+    assert state["alerts"][0]["limit"] == 1.0
+    # Alert lines are invisible to every kind-filtering reader.
+    assert SurveyJournal(str(tmp_path / "j")).completed_chunks() == {}
+    assert SurveyJournal(str(tmp_path / "j")).incidents() == []
+    report = rep.build_report(str(tmp_path / "j"))
+    assert len(report["alerts"]) == 2
+    txt = rep.render_text(report)
+    assert "alert timeline (2)" in txt and "parked_chunks" in txt
+
+
+# --------------------------------------------------- prom federation
+
+def test_prom_render_fleet_series_and_alert_gauge():
+    eng = alerts.AlertEngine([alerts.AlertRule("r1", "x", 1.0),
+                              alerts.AlertRule("r2", "y", 1.0)])
+    eng.evaluate({"now": 1.0, "x": 5.0, "y": 0.0})
+    alerts.install_engine(eng)
+    snapshots = {
+        0: fleet.snapshot(0, status={"running": True, "chunks_done": 3,
+                                     "rate_chunks_per_s": 0.5},
+                          metrics=MetricsRegistry(), ts=1.0),
+        1: fleet.snapshot(1, status={"running": False, "chunks_done": 1},
+                          ts=1.0),
+    }
+    page = prom.render(MetricsRegistry(), fleet=snapshots)
+    values = rep.parse_prom_text(page)
+    assert values["riptide_fleet_chunks_done"]['process="0"'] == 3
+    assert values["riptide_fleet_chunks_done"]['process="1"'] == 1
+    assert values["riptide_fleet_running"]['process="0"'] == 1
+    assert values["riptide_fleet_running"]['process="1"'] == 0
+    assert values["riptide_fleet_chunk_rate"]['process="0"'] == 0.5
+    assert values["riptide_fleet_obs_write_errors_total"][
+        'process="0"'] == 0
+    assert values["riptide_alert_active"]['rule="r1"'] == 1
+    assert values["riptide_alert_active"]['rule="r2"'] == 0
+    # HELP/TYPE hygiene for the federated series.
+    assert "# TYPE riptide_fleet_chunks_done gauge" in page
+    assert "# TYPE riptide_alert_active gauge" in page
+
+    # Without an engine or fleet data the page carries neither family.
+    alerts.install_engine(None)
+    page = prom.render(MetricsRegistry())
+    assert "alert_active" not in page and "riptide_fleet" not in page
+
+    # An installed fleet SOURCE federates without the explicit arg
+    # (how the scheduler wires /metrics for the run's duration).
+    prom.set_fleet_source(lambda: snapshots)
+    page = prom.render(MetricsRegistry())
+    assert 'riptide_fleet_chunks_done{process="1"} 1' in page
+
+
+def test_maybe_serve_offsets_port_by_process_index(monkeypatch):
+    captured = []
+
+    class FakeServer:
+        port = 0
+
+        def set_registry(self, registry):
+            pass
+
+    monkeypatch.setattr(prom, "serve",
+                        lambda port, registry=None:
+                        captured.append(port) or FakeServer())
+    monkeypatch.setattr(prom, "_server", None)
+    monkeypatch.setenv("RIPTIDE_PROM_PORT", "9400")
+    assert prom.maybe_serve(process_index=3) is not None
+    assert captured == [9403]
+
+    # Flag-gated: offsetting off binds the literal port everywhere.
+    monkeypatch.setattr(prom, "_server", None)
+    monkeypatch.setenv("RIPTIDE_PROM_PORT_OFFSET", "0")
+    prom.maybe_serve(process_index=3)
+    assert captured == [9403, 9400]
+
+    # Process 0 (and jax-less processes: _detect_process_index -> 0)
+    # binds the base port with the offset on.
+    monkeypatch.setattr(prom, "_server", None)
+    monkeypatch.delenv("RIPTIDE_PROM_PORT_OFFSET")
+    prom.maybe_serve()
+    assert captured[-1] == 9400
+
+
+# ------------------------------------------------------------- rwatch CLI
+
+def test_rwatch_once_exit_codes(tmp_path):
+    rwatch = _tool("rwatch")
+
+    # Missing directory: usage error.
+    assert rwatch.main([str(tmp_path / "nope"), "--once"]) == 2
+    # Bad rule spec: usage error.
+    os.makedirs(tmp_path / "empty")
+    assert rwatch.main([str(tmp_path / "empty"), "--once",
+                        "--rules", "bogus:1"]) == 2
+
+    # Healthy complete journal: exit 0, no events.
+    j = SurveyJournal(str(tmp_path / "ok"))
+    j.write_header("s", 2)
+    for cid in range(2):
+        j.record_chunk(cid, [f"{cid}.inf"], [float(cid)], [],
+                       timings={"chunk_s": 1.0, "wire_s": 0.2,
+                                "queue_s": 0.1, "collect_s": 0.5,
+                                "host_s": 0.2, "bound": "device"})
+    out = str(tmp_path / "ok.json")
+    assert rwatch.main([str(tmp_path / "ok"), "--once", "--quiet",
+                        "--json", out]) == 0
+    with open(out) as fobj:
+        result = json.load(fobj)
+    assert result["complete"] and not result["events"]
+
+    # A parked chunk with the parked_chunks rule: unresolved, exit 1.
+    j = SurveyJournal(str(tmp_path / "parked"))
+    j.write_header("p", 2)
+    j.record_parked(1, "breaker open")
+    out = str(tmp_path / "parked.json")
+    assert rwatch.main([str(tmp_path / "parked"), "--once", "--quiet",
+                        "--rules", "parked_chunks", "--json", out]) == 1
+    with open(out) as fobj:
+        result = json.load(fobj)
+    assert result["unresolved"] == ["parked_chunks"]
+    assert [e["event"] for e in result["events"]] == ["fired"]
+
+
+def test_rwatch_follow_until_complete(tmp_path):
+    """The follow loop over a journal that completes between polls:
+    a straggler fires mid-run and resolves when the window slides past
+    it, and rwatch exits 0 at completion."""
+    rwatch = _tool("rwatch")
+    rep_mod = _tool("rreport").load_report_module()
+    al = rwatch.load_alerts_module()
+
+    j = SurveyJournal(str(tmp_path / "j"))
+    j.write_header("s", 14)
+
+    def add_chunk(cid, chunk_s):
+        j.record_chunk(cid, [f"{cid}.inf"], [float(cid)], [],
+                       timings={"chunk_s": chunk_s, "wire_s": 0.0,
+                                "queue_s": 0.0, "collect_s": 0.0,
+                                "host_s": chunk_s, "bound": "device"})
+
+    # Scripted producer: two healthy chunks, a straggler, then enough
+    # healthy chunks that the 8-chunk window slides past it.
+    script = iter([(2, 1.0), (3, 30.0)] + [(cid, 1.0)
+                                           for cid in range(4, 14)])
+    add_chunk(0, 1.0)
+    add_chunk(1, 1.0)
+
+    def sleep(_):
+        try:
+            cid, wall = next(script)
+        except StopIteration:
+            raise AssertionError("rwatch kept polling after completion")
+        add_chunk(cid, wall)
+
+    code, result = rwatch.watch(
+        rep_mod, al, str(tmp_path / "j"),
+        rules=al.rules_from_spec("straggler_ratio:8.0"),
+        interval=0.0, sleep=sleep)
+    assert code == 0
+    assert [(e["event"], e["rule"]) for e in result["events"]] == \
+        [("fired", "straggler_ratio"), ("resolved", "straggler_ratio")]
+    assert result["complete"] and not result["unresolved"]
+
+    # --timeout on a run that never completes: exit 3.
+    j2 = SurveyJournal(str(tmp_path / "stuck"))
+    j2.write_header("s2", 5)
+    clock = iter([0.0, 0.0, 5.0, 10.0, 20.0, 30.0, 40.0])
+    code, result = rwatch.watch(
+        rep_mod, al, str(tmp_path / "stuck"),
+        rules=al.rules_from_spec("parked_chunks"),
+        interval=0.0, timeout=15.0, sleep=lambda _: None,
+        clock=lambda: next(clock))
+    assert code == 3 and result["timed_out"]
+
+
+# ------------------------------------------------------- scheduler e2e
+
+TOBS, TSAMP, PERIOD = 16.0, 1e-3, 0.5
+
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+
+def _searcher():
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+
+    return BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                         SEARCH_CONF, fmt="presto", io_threads=1)
+
+
+def test_scheduler_alerts_and_fleet_e2e(tmp_path, monkeypatch):
+    """A journaled survey with the engine on and an injected straggle:
+    the alert journals + mirrors as incidents + flips the gauge, the
+    fleet sidecar publishes per-chunk and finishes at running=false,
+    and /status carries both the alert map and the merged fleet
+    block."""
+    from riptide_tpu.survey.scheduler import SurveyScheduler
+
+    monkeypatch.setenv("RIPTIDE_ALERTS", "1")
+    monkeypatch.setenv("RIPTIDE_ALERT_RULES", "straggler_ratio:3.0")
+    # 5 chunks with a 5 s straggle on chunk 1: by the last evaluations
+    # the windowed median is a healthy tiny chunk, so the ratio
+    # breaches 3.0 decisively even when chunk 0 paid a cold compile.
+    files = [
+        generate_data_presto(str(tmp_path), f"w_DM{dm:.2f}", tobs=TOBS,
+                             tsamp=TSAMP, period=PERIOD, dm=float(dm))
+        for dm in (0.0, 5.0, 10.0, 15.0, 20.0)
+    ]
+    jdir = str(tmp_path / "j")
+    get_metrics().reset()
+    sched = SurveyScheduler(
+        _searcher(), [[f] for f in files], journal=SurveyJournal(jdir),
+        faults=FaultPlan.parse("straggle:1:5.0"))
+    peaks = sched.run()
+    assert peaks
+
+    state = rep.read_journal(jdir)
+    events = [(a["event"], a["rule"]) for a in state["alerts"]]
+    assert ("fired", "straggler_ratio") in events, events
+    inc = [i["incident"] for i in state["incidents"]]
+    assert "alert_fired" in inc
+    # The alert_fired incident carries the rule in its detail block.
+    [fired] = [i for i in state["incidents"]
+               if i["incident"] == "alert_fired"]
+    assert fired["detail"]["rule"] == "straggler_ratio"
+
+    # Fleet sidecar: per-chunk publication, final state at rest.
+    snapshots = rep.read_fleet(jdir)
+    assert sorted(snapshots) == [0]
+    assert snapshots[0]["chunks_done"] == 5
+    assert snapshots[0]["running"] is False
+    assert snapshots[0]["survey_id"] == sched.survey_id
+    assert snapshots[0]["bound_counts"]  # per-chunk bound labels
+
+    # /status: alert map + merged fleet block; the installed engine
+    # backs the prom gauge.
+    st = sched.status()
+    assert st["alerts"]["straggler_ratio"] is True  # 5 chunks: the
+    # 8-chunk window never slides past the straggler, so it stays
+    # firing (resolution is the demo's/unit tests' territory)
+    assert st["fleet"]["nprocesses"] == 1
+    assert alerts.get_engine() is sched.alerts
+    page = prom.render(sched.metrics)
+    assert 'riptide_alert_active{rule="straggler_ratio"} 1' in page
+    assert 'riptide_fleet_chunks_done{process="0"} 5' in page
+
+    # rtop renders the fleet summary + per-process rows.
+    rtop = _tool("rtop")
+    rep_mod = _tool("rreport").load_report_module()
+    frame = rtop.render_frame(rep_mod, jdir, show_fleet=True)
+    assert "fleet (1 process(es))" in frame and "p0:" in frame
+    assert "FIRING: straggler_ratio" in frame
+
+
+def test_bad_alert_spec_fails_without_leaking_hooks(tmp_path,
+                                                    monkeypatch):
+    """A typo'd RIPTIDE_ALERT_RULES fails the run at start — BEFORE
+    the incident sink and storage-fault hook are installed, so the
+    failed run leaks neither into whatever runs next in the
+    process."""
+    from riptide_tpu.survey.scheduler import SurveyScheduler
+
+    monkeypatch.setenv("RIPTIDE_ALERTS", "1")
+    monkeypatch.setenv("RIPTIDE_ALERT_RULES", "tunnle_bound:3")
+
+    def sentinel_sink(rec):
+        pass
+
+    def sentinel_hook(op, site, path=None):
+        return None
+
+    incidents.set_sink(sentinel_sink)
+    fsio.set_storage_faults(sentinel_hook)
+    sched = SurveyScheduler(object(), [["a.inf"]],
+                            journal=SurveyJournal(str(tmp_path / "j")))
+    with pytest.raises(ValueError, match="RIPTIDE_ALERT_RULES"):
+        sched.run()
+    assert incidents.set_sink(None) is sentinel_sink
+    assert fsio.set_storage_faults(None) is sentinel_hook
+
+
+def test_alerts_off_by_default_and_fleet_flag(tmp_path, monkeypatch):
+    """Without RIPTIDE_ALERTS the scheduler builds no engine and
+    journals no alert records; with RIPTIDE_FLEET=0 no sidecar is
+    written (the pre-PR-14 on-disk layout, byte for byte)."""
+    from riptide_tpu.survey.scheduler import SurveyScheduler
+
+    monkeypatch.delenv("RIPTIDE_ALERTS", raising=False)
+    monkeypatch.setenv("RIPTIDE_FLEET", "0")
+    f1 = generate_data_presto(str(tmp_path), "q_DM0.00", tobs=TOBS,
+                              tsamp=TSAMP, period=PERIOD, dm=0.0)
+    jdir = str(tmp_path / "j")
+    get_metrics().reset()
+    sched = SurveyScheduler(_searcher(), [[f1]],
+                            journal=SurveyJournal(jdir))
+    sched.run()
+    assert sched.alerts is None
+    state = rep.read_journal(jdir)
+    assert state["alerts"] == []
+    assert rep.read_fleet(jdir) == {}
+    assert not [p for p in os.listdir(jdir) if p.startswith("fleet_")]
+    st = sched.status()
+    assert "alerts" not in st and "fleet" not in st
+
+
+# ------------------------------------------------ pre-PR-14 compat
+
+def _write_pre_pr14_journal(tmp_path):
+    """A journal exactly as PR <= 13 wrote it: chunk records with
+    timings but no alert records and no fleet sidecars."""
+    j = SurveyJournal(str(tmp_path / "old"))
+    _append_line(j.journal_path, {
+        "kind": "header", "version": 1, "survey_id": "oldsurvey",
+        "chunks_total": 2,
+    })
+    for cid in range(2):
+        _append_line(j.journal_path, {
+            "kind": "chunk", "chunk_id": cid, "files": [f"{cid}.inf"],
+            "dms": [float(cid)], "wire_digest": None,
+            "peaks_offset": 0, "peaks_count": 0, "attempts": 1,
+            "timings": {"chunk_s": 1.0, "wire_s": 0.2, "queue_s": 0.1,
+                        "collect_s": 0.5, "host_s": 0.2,
+                        "bound": "device"},
+        })
+    return str(tmp_path / "old")
+
+
+def test_pre_pr14_journal_renders_unchanged(tmp_path):
+    jdir = _write_pre_pr14_journal(tmp_path)
+
+    # Resume loader unaffected.
+    assert sorted(SurveyJournal(jdir).completed_chunks()) == [0, 1]
+
+    # Report: no fleet section, empty alert timeline, and the human
+    # rendering carries neither block.
+    report = rep.build_report(jdir)
+    assert "fleet" not in report and report["alerts"] == []
+    txt = rep.render_text(report)
+    assert "fleet" not in txt and "alert" not in txt
+
+    # rtop: frame identical in shape to pre-PR-14 (no fleet/alert
+    # lines, with or without --fleet).
+    rtop = _tool("rtop")
+    rep_mod = _tool("rreport").load_report_module()
+    for show_fleet in (False, True):
+        frame = rtop.render_frame(rep_mod, jdir, show_fleet=show_fleet)
+        assert "fleet" not in frame and "alert" not in frame
+        assert "chunks 2/2" in frame
+
+    # rwatch: follows it cleanly, exits 0.
+    rwatch = _tool("rwatch")
+    assert rwatch.main([jdir, "--once", "--quiet"]) == 0

@@ -46,12 +46,16 @@ def _bar(frac, width=32):
     return "[" + "#" * full + "-" * (width - full) + "]"
 
 
-def render_frame(rep, journal_dir, now=None, follower=None):
+def render_frame(rep, journal_dir, now=None, follower=None,
+                 show_fleet=False):
     """One frame of the progress view as a string (a function of the
     on-disk journal state — the unit tests call it directly). The live
     loop passes a persistent ``JournalFollower`` so successive frames
     only parse newly appended records; without one the journal is read
-    whole (the --once path)."""
+    whole (the --once path). With fleet sidecars present the frame
+    carries a one-line fleet summary; ``show_fleet`` (the ``--fleet``
+    flag) expands it to per-process rows with straggler/stale/breaker
+    highlighting. Journals without sidecars render exactly as before."""
     now = time.time() if now is None else now
     j = (follower.poll() if follower is not None
          else rep.read_journal(journal_dir))
@@ -104,6 +108,27 @@ def render_frame(rep, journal_dir, now=None, follower=None):
             for p, ts in sorted(beats.items()))
         lines.append(f"heartbeats: {ages}")
 
+    snapshots = rep.read_fleet(journal_dir)
+    if snapshots:
+        fleet = rep.merge_fleet(snapshots, now=now)
+        fleet_lines = rep.render_fleet_text(fleet)
+        if show_fleet:
+            lines.extend(fleet_lines)
+        else:
+            lines.append(fleet_lines[0] + "  (--fleet for per-process "
+                                          "rows)")
+
+    alerts = j.get("alerts") or []
+    if alerts:
+        firing = {}
+        for al in alerts:
+            firing[al.get("rule")] = al.get("event") == "fired"
+        active = sorted(r for r, f in firing.items() if f)
+        lines.append(
+            f"alerts: {len(alerts)} event(s)"
+            + (f", FIRING: {', '.join(active)}" if active
+               else ", all resolved"))
+
     if j["incidents"]:
         lines.append(f"incidents ({len(j['incidents'])}):")
         for inc in j["incidents"][-INCIDENT_TAIL:]:
@@ -126,6 +151,9 @@ def main(argv=None):
                     help="refresh period in seconds (default 2)")
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit")
+    ap.add_argument("--fleet", action="store_true",
+                    help="expand the fleet summary into per-process "
+                         "rows (skew/staleness highlighting)")
     args = ap.parse_args(argv)
 
     rep = load_report_module()
@@ -134,12 +162,14 @@ def main(argv=None):
               file=sys.stderr)
         return 2
     if args.once:
-        sys.stdout.write(render_frame(rep, args.journal))
+        sys.stdout.write(render_frame(rep, args.journal,
+                                      show_fleet=args.fleet))
         return 0
     follower = rep.JournalFollower(args.journal)
     try:
         while True:
-            frame = render_frame(rep, args.journal, follower=follower)
+            frame = render_frame(rep, args.journal, follower=follower,
+                                 show_fleet=args.fleet)
             # Clear + home, then the frame: a flicker-free-enough
             # redraw without a curses dependency.
             sys.stdout.write("\x1b[2J\x1b[H" + frame)

@@ -1,0 +1,378 @@
+#!/usr/bin/env python
+"""
+watch-demo: end-to-end acceptance of the fleet observability plane
+(PR 14) — the detect half of the loop, proven live on the CPU backend.
+
+Four legs:
+
+1. **control** — a tiny survey (subprocess) with fleet sidecars on;
+   its ``peaks.csv`` bytes are the reference.
+2. **fleet-ENOSPC** — the same survey with ``enospc:fleet_snapshot``
+   injected on EVERY sidecar write: the survey must complete, peaks
+   must be byte-identical to control, and the journal must carry the
+   ``obs_write_failed`` degradation — fleet writes are proven
+   never-fatal.
+3. **two-process fleet run** — process 1 (subprocess) surveys its own
+   shard, journaling into its own directory but federating its
+   ``fleet_0001.json`` into the shared run directory; process 0 (in
+   this process) surveys the main shard there with the alert engine on
+   and an injected **straggle** fault. Meanwhile:
+
+   * ``tools/rwatch.py`` follows the run from ANOTHER process and must
+     see the ``straggler_ratio`` alert fire, then resolve, and exit 0;
+   * a poller thread scrapes the live endpoint: the ``/status``
+     ``fleet`` block must merge both processes and
+     ``riptide_alert_active{rule="straggler_ratio"}`` must be observed
+     at 1 DURING the run and 0 after it;
+   * the journal must hold the ``alert`` records (fired + resolved)
+     and the ``alert_fired``/``alert_resolved`` incidents;
+   * ``rtop --fleet`` renders the per-process rows.
+
+4. **rwatch exit codes** — ``--once`` over the healthy finished run
+   exits 0; over a synthetic journal with a parked chunk (the
+   ``parked_chunks`` rule) exits 1; over a missing directory exits 2.
+
+Output directory: /tmp/riptide_watch_demo (or argv[1]). ``make
+watch-demo`` runs this; it is wired into ``make check-full``.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Compiled search programs repeat identically across the demo's legs;
+# the jax persistent cache keeps every leg after the first (and the
+# in-process run) to ~import cost.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/riptide_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.normpath(os.path.join(HERE, ".."))
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, HERE)
+
+TOBS, TSAMP, PERIOD = 12.0, 1e-3, 0.5
+
+# Deliberately heavier than the chaos/report demos (wider bins range):
+# the straggler rule compares chunk wall-clocks, so the healthy chunks
+# must be substantial enough that scheduler jitter cannot fake an 8x
+# outlier.
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.2, "period_max": 2.0,
+                   "bins_min": 64, "bins_max": 128},
+    "find_peaks": {"smin": 6.0},
+}]
+
+# The straggler rule's demo tuning: chunk 1 is wedged STRAGGLE_S
+# inside the dispatch (well beyond LIMIT x the healthy-chunk median),
+# and the survey runs enough chunks that the 8-chunk watch window
+# slides past BOTH the straggler and chunk 0's compile warmup before
+# the end — so the alert provably fires AND resolves.
+N_CHUNKS_P0 = 12
+N_CHUNKS_P1 = 3
+STRAGGLE_CHUNK, STRAGGLE_S = 1, 8.0
+RULES = "straggler_ratio:8.0"
+
+
+def _get(url, timeout=5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+    except OSError:
+        return None, ""
+
+
+def _child_env(ledger=None):
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    for name in ("RIPTIDE_FAULT_INJECT", "RIPTIDE_PROM_PORT",
+                 "RIPTIDE_ALERTS", "RIPTIDE_ALERT_RULES"):
+        env.pop(name, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if ledger:
+        env["RIPTIDE_LEDGER"] = ledger
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   "/tmp/riptide_tpu_jax_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    return env
+
+
+def _run_child(cfg, cfg_path, timeout_s=300.0, wait=True):
+    with open(cfg_path, "w") as fobj:
+        json.dump(cfg, fobj, indent=1)
+    cmd = [sys.executable, os.path.join(HERE, "watch_demo.py"),
+           "--child", cfg_path]
+    if not wait:
+        return subprocess.Popen(cmd, env=_child_env(), cwd=ROOT,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+    proc = subprocess.run(cmd, env=_child_env(), cwd=ROOT,
+                          capture_output=True, text=True,
+                          timeout=timeout_s)
+    assert proc.returncode == 0, \
+        f"child leg failed ({proc.returncode}):\n" \
+        + "\n".join(proc.stderr.splitlines()[-20:])
+    return proc
+
+
+def _child_main(cfg_path):
+    """One subprocess survey leg (control / ENOSPC / fleet process 1):
+    run the configured shard through the checkpointed scheduler with
+    fleet writes federating into ``fleet_dir``."""
+    with open(cfg_path) as fobj:
+        cfg = json.load(fobj)
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+    from riptide_tpu.survey.faults import FaultPlan
+    from riptide_tpu.survey.journal import SurveyJournal
+    from riptide_tpu.survey.scheduler import SurveyScheduler
+
+    searcher = BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                             SEARCH_CONF, fmt="presto", io_threads=1)
+    scheduler = SurveyScheduler(
+        searcher, [[f] for f in cfg["files"]],
+        journal=SurveyJournal(cfg["journal"]),
+        faults=FaultPlan.parse(cfg.get("faults") or ""),
+        process_index=int(cfg.get("process_index", 0)),
+        fleet_dir=cfg.get("fleet_dir"),
+    )
+    peaks = scheduler.run()
+    if cfg.get("peaks_csv"):
+        import pandas
+
+        pandas.DataFrame.from_dict(
+            [p.summary_dict() for p in peaks]
+        ).to_csv(cfg["peaks_csv"], sep=",", index=False,
+                 float_format="%.9f")
+    return 0
+
+
+def main(outdir="/tmp/riptide_watch_demo"):
+    from synth import generate_data_presto
+
+    import rreport
+    import rtop
+    import rwatch
+    from riptide_tpu.obs import prom
+    from riptide_tpu.obs import report as rep
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+    from riptide_tpu.survey.faults import FaultPlan
+    from riptide_tpu.survey.journal import SurveyJournal
+    from riptide_tpu.survey.metrics import get_metrics
+    from riptide_tpu.survey.scheduler import SurveyScheduler
+
+    shutil.rmtree(outdir, ignore_errors=True)
+    os.makedirs(outdir)
+    files_p0 = [
+        generate_data_presto(outdir, f"p0_DM{dm:.1f}", tobs=TOBS,
+                             tsamp=TSAMP, period=PERIOD, dm=float(dm),
+                             amplitude=30.0)
+        for dm in range(N_CHUNKS_P0)
+    ]
+    files_p1 = [
+        generate_data_presto(outdir, f"p1_DM{dm:.1f}", tobs=TOBS,
+                             tsamp=TSAMP, period=PERIOD, dm=float(dm),
+                             amplitude=30.0)
+        for dm in (20.0, 25.0, 30.0)
+    ]
+    assert len(files_p1) == N_CHUNKS_P1
+
+    # -- leg 1+2: fleet writes are never fatal under ENOSPC -----------
+    control_csv = os.path.join(outdir, "control.csv")
+    _run_child({"files": files_p1,
+                "journal": os.path.join(outdir, "j_control"),
+                "peaks_csv": control_csv},
+               os.path.join(outdir, "leg_control.json"))
+    enospc_csv = os.path.join(outdir, "enospc.csv")
+    _run_child({"files": files_p1,
+                "journal": os.path.join(outdir, "j_enospc"),
+                "peaks_csv": enospc_csv,
+                "faults": "enospc:fleet_snapshot:1x99"},
+               os.path.join(outdir, "leg_enospc.json"))
+    with open(control_csv, "rb") as fobj:
+        control_bytes = fobj.read()
+    with open(enospc_csv, "rb") as fobj:
+        assert fobj.read() == control_bytes, \
+            "ENOSPC on fleet writes changed the data products"
+    state = rep.read_journal(os.path.join(outdir, "j_enospc"))
+    degr = [i for i in state["incidents"]
+            if i.get("incident") == "obs_write_failed"
+            and (i.get("detail") or {}).get("op") == "fleet_snapshot"]
+    assert degr, "no obs_write_failed incident for the fleet ENOSPC"
+    assert len(state["chunks"]) == N_CHUNKS_P1, \
+        "ENOSPC leg did not complete its survey"
+    print(f"fleet-ENOSPC leg OK: survey completed, peaks byte-identical "
+          f"({len(control_bytes)} bytes), {len(degr)} degradation "
+          "incident(s)")
+
+    # -- leg 3: the two-process fleet run -----------------------------
+    jdir = os.path.join(outdir, "j")
+    jdir_p1 = os.path.join(outdir, "j_p1")
+    os.makedirs(jdir, exist_ok=True)
+
+    server = prom.serve(0)
+    base = f"http://127.0.0.1:{server.port}"
+    seen = {"gauge_high": False, "gauge_low": False, "fleet_procs": set()}
+    stop = threading.Event()
+
+    def poller():
+        while not stop.wait(0.1):
+            code, body = _get(f"{base}/metrics")
+            if code == 200:
+                for line in body.splitlines():
+                    if line.startswith('riptide_alert_active{'
+                                       'rule="straggler_ratio"}'):
+                        val = line.rsplit(None, 1)[-1]
+                        seen["gauge_high" if val == "1"
+                             else "gauge_low"] = True
+            code, body = _get(f"{base}/status")
+            if code == 200:
+                doc = json.loads(body)
+                for p in (doc.get("fleet") or {}).get("processes", {}):
+                    seen["fleet_procs"].add(p)
+
+    watcher = threading.Thread(target=poller, daemon=True)
+    watcher.start()
+
+    # Process 1: own shard, own journal, federating into jdir.
+    p1 = _run_child({"files": files_p1, "journal": jdir_p1,
+                     "fleet_dir": jdir, "process_index": 1},
+                    os.path.join(outdir, "leg_p1.json"), wait=False)
+
+    # rwatch follows the shared run directory from its own process.
+    rwatch_json = os.path.join(outdir, "rwatch.json")
+    rw = subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "rwatch.py"), jdir,
+         "--interval", "0.2", "--timeout", "240", "--rules", RULES,
+         "--json", rwatch_json],
+        env=_child_env(), cwd=ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+    # Process 0: the main shard, in this process, alert engine on.
+    os.environ["RIPTIDE_ALERTS"] = "1"
+    os.environ["RIPTIDE_ALERT_RULES"] = RULES
+    try:
+        get_metrics().reset()
+        searcher = BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                                 SEARCH_CONF, fmt="presto", io_threads=1)
+        scheduler = SurveyScheduler(
+            searcher, [[f] for f in files_p0],
+            journal=SurveyJournal(jdir), process_index=0,
+            faults=FaultPlan.parse(
+                f"straggle:{STRAGGLE_CHUNK}:{STRAGGLE_S}"),
+        )
+        peaks = scheduler.run()
+    finally:
+        del os.environ["RIPTIDE_ALERTS"]
+        del os.environ["RIPTIDE_ALERT_RULES"]
+
+    p1_out, p1_err = p1.communicate(timeout=300)
+    assert p1.returncode == 0, \
+        f"process-1 leg failed ({p1.returncode}):\n" \
+        + "\n".join(p1_err.splitlines()[-20:])
+    rw_out, rw_err = rw.communicate(timeout=300)
+    stop.set()
+    watcher.join(timeout=5.0)
+
+    # rwatch saw the fire AND the resolve, and exited clean.
+    assert rw.returncode == 0, \
+        f"rwatch exited {rw.returncode}:\n{rw_out}\n{rw_err}"
+    with open(rwatch_json) as fobj:
+        watched = json.load(fobj)
+    w_events = [(e["event"], e["rule"]) for e in watched["events"]]
+    assert ("fired", "straggler_ratio") in w_events, w_events
+    assert ("resolved", "straggler_ratio") in w_events, w_events
+    assert not watched["unresolved"], watched["unresolved"]
+    assert watched["complete"], watched
+    # rwatch exits the moment p0's journal completes; p1's sidecar is
+    # normally federated by then (it runs a much shorter shard), but
+    # the STRICT both-processes assertion lives below on the final
+    # /status + rreport views, after p1 has provably exited.
+    assert "0" in watched["fleet"]["processes"], watched["fleet"]
+
+    # The journal carries the alert records + mirrored incidents.
+    state = rep.read_journal(jdir)
+    j_events = [(a.get("event"), a.get("rule")) for a in state["alerts"]]
+    assert ("fired", "straggler_ratio") in j_events, j_events
+    assert ("resolved", "straggler_ratio") in j_events, j_events
+    inc = [i["incident"] for i in state["incidents"]]
+    assert "alert_fired" in inc and "alert_resolved" in inc, inc
+
+    # Live surfaces: the gauge was observed at 1 during the run and is
+    # 0 now; the /status fleet block merged both processes.
+    code, body = _get(f"{base}/metrics")
+    assert code == 200 and \
+        'riptide_alert_active{rule="straggler_ratio"} 0' in body, \
+        [l for l in body.splitlines() if "alert_active" in l]
+    assert seen["gauge_high"], \
+        "poller never saw riptide_alert_active=1 during the run"
+    code, body = _get(f"{base}/status")
+    final = json.loads(body)
+    assert code == 200 and \
+        set(final["fleet"]["processes"]) == {"0", "1"}, final.get("fleet")
+    assert "0" in seen["fleet_procs"], \
+        "poller never saw the /status fleet block"
+    assert final["fleet"]["chunks_done"] == N_CHUNKS_P0 + N_CHUNKS_P1, \
+        final["fleet"]
+
+    # The /metrics page federates both processes' fleet series.
+    code, body = _get(f"{base}/metrics")
+    assert 'riptide_fleet_chunks_done{process="0"}' in body
+    assert 'riptide_fleet_chunks_done{process="1"}' in body
+
+    # rtop --fleet renders the per-process rows.
+    rep_mod = rreport.load_report_module()
+    frame = rtop.render_frame(rep_mod, jdir, show_fleet=True)
+    assert "p0:" in frame and "p1:" in frame, frame
+    assert "alerts:" in frame, frame
+
+    # rreport's fleet section over the same files.
+    rc = rreport.main([jdir, "--quiet", "--json",
+                       os.path.join(outdir, "report.json")])
+    assert rc == 0, f"rreport exited {rc}"
+    with open(os.path.join(outdir, "report.json")) as fobj:
+        report = json.load(fobj)
+    assert report["fleet"]["nprocesses"] == 2, report["fleet"]
+    assert len(report["alerts"]) >= 2, report["alerts"]
+
+    # -- leg 4: rwatch exit codes -------------------------------------
+    rc = rwatch.main([jdir, "--once", "--rules", RULES, "--quiet"])
+    assert rc == 0, f"rwatch --once on a healthy run exited {rc}"
+    parked_dir = os.path.join(outdir, "j_parked")
+    j = SurveyJournal(parked_dir)
+    j.write_header("demo-parked", 2)
+    j.record_parked(1, "demo: breaker open")
+    rc = rwatch.main([parked_dir, "--once", "--rules", "parked_chunks",
+                      "--quiet"])
+    assert rc == 1, f"rwatch --once with a parked chunk exited {rc}"
+    rc = rwatch.main([os.path.join(outdir, "nope"), "--once"])
+    assert rc == 2, f"rwatch on a missing directory exited {rc}"
+
+    server.close()
+    print(f"\nwatch demo OK: {len(peaks)} peaks from "
+          f"{N_CHUNKS_P0}+{N_CHUNKS_P1} chunks across 2 processes")
+    print(f"  run dir    ->  {jdir}")
+    print(f"  rwatch     ->  {rwatch_json} "
+          f"({len(watched['events'])} events, exit 0)")
+    print("  straggler_ratio fired AND resolved: journal alert records, "
+          "alert_fired/alert_resolved incidents,")
+    print("  riptide_alert_active gauge observed 1 live then 0; "
+          "/status fleet block merged p0+p1;")
+    print("  fleet ENOSPC leg completed byte-identical to control; "
+          "rwatch exit codes 0/1/2 verified\n")
+    sys.stdout.write(frame)
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        sys.exit(_child_main(sys.argv[2]))
+    sys.exit(main(*sys.argv[1:2]))
