@@ -2,8 +2,9 @@
 
 PYTHON ?= python
 
-.PHONY: install check check-full prove lint native-asan sanitize tests \
-	tests-cov native bench trace-demo report-demo watch-demo chaos clean
+.PHONY: install check check-full prove repin lint native-asan sanitize \
+	tests tests-cov native bench trace-demo report-demo watch-demo \
+	chaos clean
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -26,6 +27,18 @@ check:
 # workflow).
 prove:
 	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py
+
+# The ONE audited step for a deliberate KERNEL_CACHE_VERSION bump:
+# re-pin the kernel bytecode digest (tests/test_kernel_cache_version.py)
+# and the semantic program contracts (tools/plan_contracts.json) in
+# order, then re-verify. rprove's ABSOLUTE rules (no f64 on device, no
+# dropped donations, zero pack programs on fused stages) are enforced
+# even against a freshly written pin, so `make repin` cannot launder a
+# genuinely bad kernel change — it only blesses layout/shape drift.
+repin:
+	$(PYTHON) tools/update_kernel_digest.py
+	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py --update --all
+	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py --all
 
 # The CI form: AST analyzers uncached + the semantic pass + the fleet/
 # alert e2e acceptance (watch-demo).

@@ -269,6 +269,16 @@ def bench_headline():
         return _pipeline_pass(plan, tobs, CHUNKS, dms,
                               lambda i: batches[i % 2], prepper, shipper)
 
+    # Container-occupancy accounting of the plan's kernel layout (live
+    # vs padded row*lane work, row-pack pairing, reduction vs the
+    # legacy layout): the machine-readable form of the perf_notes
+    # occupancy claims, carried on every emitted line and ledger row.
+    from riptide_tpu.search.plan import plan_occupancy
+
+    occ = plan_occupancy(plan)
+    occupancy = dict(occ["totals"], pairs=occ["pairs"],
+                     row_pack=occ["row_pack"])
+
     def emit(elapsed, npasses, sub):
         trials_per_sec = D * CHUNKS / elapsed
         line = {
@@ -279,6 +289,7 @@ def bench_headline():
                 trials_per_sec * REF_SECONDS_PER_TRIAL, 2
             ),
             "passes": npasses,
+            "occupancy": occupancy,
         }
         line.update(sub)
         print(json.dumps(line), flush=True)
@@ -320,7 +331,8 @@ def bench_headline():
     _ledger_row("bench", best_sub, CHUNKS,
                 {"metric": "dm_trials_per_sec_2p23_samples",
                  "value": round(D * CHUNKS / best, 3),
-                 "passes": npasses})
+                 "passes": npasses,
+                 "occupancy": occupancy})
 
 
 def _warm_plan(nsamp, tsamp, period_min, period_max, bins_min, bins_max,

@@ -39,7 +39,7 @@ from .quality import (
     QuarantinedSeries,
 )
 
-__version__ = "0.13.0"
+__version__ = "0.14.0"
 
 
 def test():

@@ -100,6 +100,13 @@ def get_parser():
         "profile plugin or Perfetto) — the device-side complement of "
         "--trace's host spans",
     )
+    parser.add_argument(
+        "--plan-stats", action="store_true",
+        help="Print the search plan's container-occupancy accounting "
+        "(live vs padded row*lane work per bucket, row-pack pairing, "
+        "and the padded-work reduction vs the legacy layout) as JSON "
+        "and exit without searching",
+    )
     parser.add_argument("fname", type=str,
                         help="Path of the time series file to search")
     parser.add_argument("--version", action="version", version=__version__)
@@ -270,6 +277,25 @@ def run_program(args):
 
     loaders = {"sigproc": TimeSeries.from_sigproc, "presto": TimeSeries.from_presto_inf}
     ts = loaders[args.format](args.fname)
+
+    if getattr(args, "plan_stats", False):
+        # Occupancy accounting only: build the same plan the search
+        # would (detrending does not change the sample count) and emit
+        # the machine-readable live-vs-padded layout report.
+        import json
+
+        from riptide_tpu.ffautils import generate_width_trials
+        from riptide_tpu.search.plan import periodogram_plan, plan_occupancy
+
+        widths = generate_width_trials(args.bmin, ducy_max=0.3,
+                                       wtsp=args.wtsp)
+        plan = periodogram_plan(
+            ts.nsamp, ts.tsamp, tuple(int(w) for w in widths),
+            float(args.Pmin), float(args.Pmax), int(args.bmin),
+            int(args.bmax),
+        )
+        print(json.dumps(plan_occupancy(plan), indent=2))
+        return None
 
     log.debug(
         f"Searching period range [{args.Pmin}, {args.Pmax}] seconds "

@@ -19,9 +19,13 @@ batch. Inputs per program (d, b):
   x     (D, B, rows, P)  f32 natural-packed rows (zero padded), HBM
   tab   (B, T, rows, 128) int32 packed level words (slottables layout),
         lane-replicated on device, HBM; T = NL + 2*(L - NL)
-  scal  (B, 32) int32 SMEM: [0]=p, [1]=P-p, [2+2j], [3+2j] = spread
-        roll amounts of step j (precomputed mod rows)
-  coef  (B, 32) f32 SMEM: [w] = (h_w+b_w)/stdnoise, [NWPAD+w] = b_w/stdnoise
+  scal  (B, SCAL_SLOTS) int32 SMEM: [0]=p, [1]=guest base row (rows
+        when the trial has no row-packed guest), [2+2j], [3+2j] =
+        spread roll amounts of step j (precomputed mod rows),
+        [32+3j..34+3j] = the guest's three per-step amounts
+  coef  (B, COEF_SLOTS) f32 SMEM: [w] = (h_w+b_w)/stdnoise,
+        [NWPAD+w] = b_w/stdnoise, then the same two banks for a
+        row-packed guest trial at [2*NWPAD+w] / [3*NWPAD+w]
 Output:
   snr   (D, B, RS, 128) f32; lanes [0, NW) hold widths, rows [0, m)
         valid. (CycleKernel.__call__ also accepts/returns the 3-D
@@ -45,12 +49,20 @@ log = logging.getLogger("riptide_tpu.ffa_kernel")
 from ..utils import envflags
 from ..utils.compat import pallas_compiler_params
 from .slottables import (A_SHIFT, A_BITS, B_SHIFT, B_BITS, NAT_LEVELS,
-                         PH_BITS, PH_MASK, build_tables)
+                         PH_BITS, PH_MASK, build_tables, combine_tables)
 
 __all__ = ["ffa_snr_cycle", "NWPAD", "VMEM_LIMIT", "kernel_vmem_bytes",
            "WIRE_MODES", "pack_gather_words"]
 
 NWPAD = 16  # coef slots reserved per coefficient bank
+# SMEM bank widths (v7): scal [0]=p, [1]=guest base row (rows = no
+# guest), [2+2j]/[3+2j] host spread rolls, [32+3j..34+3j] guest spread
+# rolls; coef holds four NWPAD-slot banks (host (h+b)/std, host b/std,
+# guest (h+b)/std, guest b/std). Row-packed pairs ride entirely in
+# these per-trial slots — the kernel body is shared across paired and
+# lone trials of one bucket.
+SCAL_SLOTS = 64
+COEF_SLOTS = 4 * NWPAD
 
 # Quantised wire transports the FUSED kernel prologue can decode in
 # VMEM: mode -> (group, planes). ``group`` consecutive view rows of the
@@ -95,9 +107,16 @@ def _prcap(rows, group):
     return -(-need // DMA_CHUNK) * DMA_CHUNK
 
 
-def pack_gather_words(ms, ps, rows, PW):
+def pack_gather_words(ms, ps, rows, PW, guests=None):
     """(B, rows) int32 pack words (see PK_* layout above) for one
-    bucket's problems against a plan-wide view width ``PW``."""
+    bucket's problems against a plan-wide view width ``PW``.
+
+    ``guests``: optional per-problem list of ``(m_guest, base)`` (or
+    None) — rows at or above ``base`` carry the GUEST trial's drift
+    against its own view (which the paired kernel places at ``base``
+    in the barrel source), so one MSB-first barrel packs both trials:
+    a guest row's drift never exceeds its distance to ``base``, hence
+    every barrel read of a live row stays inside its own region."""
     B = len(ms)
     out = np.zeros((B, rows), np.int32)
     i = np.arange(rows, dtype=np.int64)
@@ -109,9 +128,16 @@ def pack_gather_words(ms, ps, rows, PW):
         assert p <= PW and s.max() < (1 << PK_S_BITS), (p, PW, rows)
         assert r.max() < (1 << PK_R_BITS)
         w = r | (s << PK_S_SHIFT)
-        out[bi] = np.where(i < m, w | (1 << 31), w).astype(np.int64).astype(
-            np.int32
-        )
+        w = np.where(i < m, w | (1 << 31), w)
+        g = guests[bi] if guests else None
+        if g is not None:
+            mg, base = int(g[0]), int(g[1])
+            ig = np.maximum(i - base, 0)
+            qg = (ig * p) // PW
+            wg = ((ig * p) % PW) | ((ig - qg) << PK_S_SHIFT)
+            wg = np.where(ig < mg, wg | (1 << 31), wg)
+            w = np.where(i >= base, wg, w)
+        out[bi] = w.astype(np.int64).astype(np.int32)
     return out
 
 # Scoped-VMEM budget shared by the kernel's CompilerParams and the
@@ -138,7 +164,7 @@ N_LIVE_FUSED = 4
 
 
 def kernel_vmem_bytes(L, NL, rows, P, resident_tables, fused_mode=None,
-                      PW=None):
+                      PW=None, gext=None):
     """Worst-case scoped-VMEM bytes of one kernel program.
 
     ``resident_tables=True`` accounts for the persistent all-levels
@@ -146,7 +172,9 @@ def kernel_vmem_bytes(L, NL, rows, P, resident_tables, fused_mode=None,
     ``False`` is the streaming fallback (one level table at a time).
     ``fused_mode`` adds the fused wire->container prologue's scratch
     (byte planes, decoded view, scales, pack-barrel temporaries) for a
-    plan view width ``PW``.
+    plan view width ``PW``. ``gext`` (row-packed pairs only) is the
+    bucket's largest guest container extent: it sizes the guest wire
+    scratch of the paired prologue plus its extra merge temporaries.
     """
     bufs = N_LIVE_BUFS * rows * P * 4
     extra_tab = 1 if fused_mode else 0
@@ -158,6 +186,11 @@ def kernel_vmem_bytes(L, NL, rows, P, resident_tables, fused_mode=None,
         tot += planes * prcap * PW              # byte-plane scratch (u8)
         tot += group * prcap * (PW * 4 + 4)     # decoded view + row scales
         tot += N_LIVE_FUSED * rows * PW * 4     # pack barrel temporaries
+        if gext is not None:
+            prg = _prcap(gext, group)
+            tot += planes * prg * PW            # guest byte planes (u8)
+            tot += group * prg * (PW * 4 + 4)   # guest view + scales
+            tot += 3 * rows * PW * 4            # pad/roll/merge temporaries
     return tot
 
 
@@ -167,7 +200,7 @@ def kernel_vmem_bytes(L, NL, rows, P, resident_tables, fused_mode=None,
 RESIDENT_TABLE_CAP = 12 * 1024 * 1024
 
 
-def tables_resident(L, NL, rows, P, fused_mode=None, PW=None):
+def tables_resident(L, NL, rows, P, fused_mode=None, PW=None, gext=None):
     """Whether the per-bins-trial all-levels table scratch is used:
     it must fit the VMEM budget AND stay under the compiler-friendly
     size cap (larger scratches crash the Mosaic compiler — deeper
@@ -178,7 +211,8 @@ def tables_resident(L, NL, rows, P, fused_mode=None, PW=None):
     ntab = num_level_tables(L, NL) + (1 if fused_mode else 0)
     tab_bytes = ntab * rows * 128 * 4
     return (tab_bytes <= RESIDENT_TABLE_CAP
-            and kernel_vmem_bytes(L, NL, rows, P, True, fused_mode, PW)
+            and kernel_vmem_bytes(L, NL, rows, P, True, fused_mode, PW,
+                                  gext)
             < VMEM_LIMIT)
 
 
@@ -230,7 +264,8 @@ def _make_load_tab(tab_hbm, T, semt, b, d, resident):
 
 
 def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
-            *, L, NL, rows, P, RS, widths, nspread, pbits, resident):
+            *, L, NL, rows, P, RS, widths, nspread, pbits, resident,
+            paired):
     b = pl.program_id(0)  # bins-trial index
     d = pl.program_id(1)  # DM-trial index (tables are shared across it)
     p = scal[b, 0]
@@ -241,11 +276,13 @@ def _kernel(scal, coef, x_hbm, tab_hbm, out_ref, A, Bs, T, semx, semt,
     cp.wait()
     _cascade_body(scal, coef, lambda lev: load_tab(lev, P), out_ref,
                   A, Bs, b, p, L=L, NL=NL, rows=rows, P=P, RS=RS,
-                  widths=widths, nspread=nspread, pbits=pbits)
+                  widths=widths, nspread=nspread, pbits=pbits,
+                  paired=paired)
 
 
 def _cascade_body(scal, coef, load_tab, out_ref, A, Bs, b, p,
-                  *, L, NL, rows, P, RS, widths, nspread, pbits):
+                  *, L, NL, rows, P, RS, widths, nspread, pbits,
+                  paired=False):
     cols = jax.lax.broadcasted_iota(jnp.int32, (rows, P), 1)
     colmask = cols < p
 
@@ -292,15 +329,24 @@ def _cascade_body(scal, coef, load_tab, out_ref, A, Bs, b, p,
         cur = 1 - cur
 
     # ---- spread steps ---------------------------------------------------
+    # Row-packed pairs add the guest trial's three candidates (its
+    # depth-j block rides at in-slot offset base >> j): selects 3..5
+    # against per-trial roll amounts in the guest half of the scalar
+    # bank. Lone trials in a paired bucket simply never select them.
     for j in range(nspread):
         src, dst = bufs[cur], bufs[1 - cur]
         w = load_tab(NL + j)
-        sel = (w >> 22) & 3
+        sel = (w >> 22) & (7 if paired else 3)
         sv = src[:]
         c1 = pltpu.roll(sv, scal[b, 2 + 2 * j], axis=0)
         c2 = pltpu.roll(sv, scal[b, 3 + 2 * j], axis=0)
         out = jnp.where(sel == 1, c1, sv)
         out = jnp.where(sel == 2, c2, out)
+        if paired:
+            for sv_code, slot in ((3, 32 + 3 * j), (4, 33 + 3 * j),
+                                  (5, 34 + 3 * j)):
+                cand = pltpu.roll(sv, scal[b, slot], axis=0)
+                out = jnp.where(sel == sv_code, cand, out)
         dst[:] = jnp.where(w < 0, out, 0.0)
         cur = 1 - cur
 
@@ -360,6 +406,11 @@ def _cascade_body(scal, coef, load_tab, out_ref, A, Bs, b, p,
     lanes = jax.lax.broadcasted_iota(jnp.int32, (RS, 128), 1)
     acc = jnp.zeros((RS, 128), jnp.float32)
     neg = jnp.float32(-3.0e38)
+    if paired:
+        # Rows at or above the trial's guest base belong to the guest
+        # trial: same p and widths, its own noise normalisation.
+        riota = jax.lax.broadcasted_iota(jnp.int32, (RS, 1), 0)
+        guestrow = riota >= scal[b, 1]
     for iw, wdt in enumerate(widths):
         aw = _lane_up(cs, wdt, P)
         bw = pltpu.roll(aw, p, axis=1)
@@ -368,70 +419,38 @@ def _cascade_body(scal, coef, load_tab, out_ref, A, Bs, b, p,
         d = jnp.where(ccols < p, d, neg)
         dmax = jnp.max(d, axis=1, keepdims=True)
         snr_w = coef[b, iw] * dmax - coef[b, NWPAD + iw] * totc
+        if paired:
+            gsnr = (coef[b, 2 * NWPAD + iw] * dmax
+                    - coef[b, 3 * NWPAD + iw] * totc)
+            snr_w = jnp.where(guestrow, gsnr, snr_w)
         acc = acc + jnp.where(lanes == iw, jnp.broadcast_to(snr_w, (RS, 128)), 0.0)
     out_ref[0, 0] = acc
 
 
-def _fused_kernel(stagevec, scal, coef, wire_hbm, scales_hbm, tab_hbm,
-                  out_ref, A, Bs, T, WB, SC, semt, semw, sems,
-                  *, mode, L, NL, rows, P, RS, widths, nspread, pbits,
-                  sbits, resident, PW):
-    """Single-dispatch cascade stage: wire decode + dequant + (m, p)
-    pack + FFA + boxcar S/N in ONE Pallas program. The per-stage wire
-    bytes arrive as a slice of the shipped (D, WROWS, PW) byte-plane
-    view (dynamic row offsets from the SMEM stage vector, streamed in
-    static DMA_CHUNK-row chunks), so the former per-stage XLA pack
-    program — and its full (D, B, rows, P) f32 container round-trip
-    through HBM — disappears entirely."""
-    b = pl.program_id(0)  # bins-trial index
-    d = pl.program_id(1)  # DM-trial index (tables are shared across it)
-    p = scal[b, 0]
-    roff = stagevec[0, 0]   # stage's wire row offset (part-relative)
-    pr = stagevec[0, 1]     # stage's rows per byte plane
-    soff = stagevec[0, 2]   # stage's scale row offset
-    r0 = stagevec[0, 3]     # stage's view rows (= ceil(n / PW))
-    group, planes = WIRE_MODES[mode]
-    PR = _prcap(rows, group)
-    R0C = group * PR
-    NCH = PR // DMA_CHUNK
-
-    cps = pltpu.make_async_copy(
-        scales_hbm.at[d, pl.ds(soff, R0C)], SC, sems
+def _wire_chunk_copy(stagevec, svoff, wire_hbm, WB, semw, d, pi, c):
+    """Async copy of one static DMA_CHUNK of plane ``pi`` of the stage
+    slice whose [row offset, plane rows] sit at ``stagevec[0, svoff:]``
+    (svoff 0 = the host stage, 4 = a row-packed guest stage)."""
+    roff = stagevec[0, svoff]
+    pr = stagevec[0, svoff + 1]
+    return pltpu.make_async_copy(
+        wire_hbm.at[d, pl.ds(roff + pi * pr + c * DMA_CHUNK, DMA_CHUNK)],
+        WB.at[pi, pl.ds(c * DMA_CHUNK, DMA_CHUNK)],
+        semw.at[pi, c],
     )
-    cps.start()
 
-    def chunk_copy(pi, c):
-        return pltpu.make_async_copy(
-            wire_hbm.at[d, pl.ds(roff + pi * pr + c * DMA_CHUNK,
-                                 DMA_CHUNK)],
-            WB.at[pi, pl.ds(c * DMA_CHUNK, DMA_CHUNK)],
-            semw.at[pi, c],
-        )
 
-    # Start every needed wire chunk (plane extents are dynamic, chunk
-    # shapes static), then overlap the per-b table DMA with the stream.
-    for pi in range(planes):
-        for c in range(NCH):
-            @pl.when(c * DMA_CHUNK < pr)
-            def _start(pi=pi, c=c):
-                chunk_copy(pi, c).start()
+def _decode_planes(WB, SC, r0, *, mode, R0C, PW):
+    """Byte planes -> dequantised (R0C, PW) sample view.
 
-    load_tab = _make_load_tab(tab_hbm, T, semt, b, d, resident)
-
-    for pi in range(planes):
-        for c in range(NCH):
-            @pl.when(c * DMA_CHUNK < pr)
-            def _wait(pi=pi, c=c):
-                chunk_copy(pi, c).wait()
-    cps.wait()
-
-    # ---- decode: byte planes -> dequantised (R0C, PW) sample view ------
-    # Elementwise only: the host's plane layout groups `group`
-    # consecutive view rows per plane row, so the bit extraction never
-    # crosses lanes; the group interleave is a sublane stack/reshape
-    # (the same relayout family as the slot phase's row-doubling).
-    # Operation order matches engine._u*_decode_view exactly, so the
-    # fused container is BIT-identical to the XLA pack path's.
+    Elementwise only: the host's plane layout groups `group`
+    consecutive view rows per plane row, so the bit extraction never
+    crosses lanes; the group interleave is a sublane stack/reshape
+    (the same relayout family as the slot phase's row-doubling).
+    Operation order matches engine._u*_decode_view exactly, so the
+    fused container is BIT-identical to the XLA pack path's. Rows
+    beyond the stage's ``r0`` view rows are DMA over-read garbage
+    (possibly times a non-finite scale): zeroed BEFORE the barrels."""
     if mode == "uint8":
         xq = WB[0].astype(jnp.float32) - 128.0
     else:
@@ -448,12 +467,75 @@ def _fused_kernel(stagevec, scal, coef, wire_hbm, scales_hbm, tab_hbm,
         xq = jnp.stack(qs, axis=1).reshape(R0C, PW)
     rowi = jax.lax.broadcasted_iota(jnp.int32, (R0C, PW), 0)
     x = xq * jnp.broadcast_to(SC[:], (R0C, PW))
-    # Rows beyond the stage's view are DMA over-read garbage (possibly
-    # times a non-finite scale): zero them BEFORE the pack barrels.
-    x = jnp.where(rowi < r0, x, 0.0)
-    y = x[:rows]  # R0C >= rows + 1 by _prcap construction
+    return jnp.where(rowi < r0, x, 0.0)
 
-    # ---- pack: container[i, j] = view_flat[i * p + j] ------------------
+
+def _fused_kernel(stagevec, scal, coef, wire_hbm, scales_hbm, tab_hbm,
+                  out_ref, A, Bs, T, WB, SC, semt, semw, sems,
+                  *, mode, L, NL, rows, P, RS, widths, nspread, pbits,
+                  sbits, resident, PW):
+    """Single-dispatch cascade stage: wire decode + dequant + (m, p)
+    pack + FFA + boxcar S/N in ONE Pallas program. The per-stage wire
+    bytes arrive as a slice of the shipped (D, WROWS, PW) byte-plane
+    view (dynamic row offsets from the SMEM stage vector, streamed in
+    static DMA_CHUNK-row chunks), so the former per-stage XLA pack
+    program — and its full (D, B, rows, P) f32 container round-trip
+    through HBM — disappears entirely."""
+    b = pl.program_id(0)  # bins-trial index
+    d = pl.program_id(1)  # DM-trial index (tables are shared across it)
+    p = scal[b, 0]
+    pr = stagevec[0, 1]     # stage's rows per byte plane
+    soff = stagevec[0, 2]   # stage's scale row offset
+    r0 = stagevec[0, 3]     # stage's view rows (= ceil(n / PW))
+    group, planes = WIRE_MODES[mode]
+    PR = _prcap(rows, group)
+    R0C = group * PR
+    NCH = PR // DMA_CHUNK
+
+    cps = pltpu.make_async_copy(
+        scales_hbm.at[d, pl.ds(soff, R0C)], SC, sems
+    )
+    cps.start()
+
+    # Start every needed wire chunk (plane extents are dynamic, chunk
+    # shapes static), then overlap the per-b table DMA with the stream.
+    for pi in range(planes):
+        for c in range(NCH):
+            @pl.when(c * DMA_CHUNK < pr)
+            def _start(pi=pi, c=c):
+                _wire_chunk_copy(stagevec, 0, wire_hbm, WB, semw, d,
+                                 pi, c).start()
+
+    load_tab = _make_load_tab(tab_hbm, T, semt, b, d, resident)
+
+    for pi in range(planes):
+        for c in range(NCH):
+            @pl.when(c * DMA_CHUNK < pr)
+            def _wait(pi=pi, c=c):
+                # Pallas async-copy semaphore wait (DMA completion
+                # inside the kernel body), not a thread wait.
+                _wire_chunk_copy(  # riplint: disable=RIP004
+                    stagevec, 0, wire_hbm, WB, semw, d, pi, c).wait()
+    cps.wait()
+
+    x = _decode_planes(WB, SC, r0, mode=mode, R0C=R0C, PW=PW)
+    y = x[:rows]  # R0C >= rows + 1 by _prcap construction
+    _pack_and_cascade(scal, coef, load_tab, out_ref, A, Bs, b, p, y,
+                      L=L, NL=NL, rows=rows, P=P, RS=RS, widths=widths,
+                      nspread=nspread, pbits=pbits, sbits=sbits, PW=PW,
+                      paired=False)
+
+
+def _pack_and_cascade(scal, coef, load_tab, out_ref, A, Bs, b, p, y,
+                      *, L, NL, rows, P, RS, widths, nspread, pbits,
+                      sbits, PW, paired):
+    """Pack the (rows, PW) barrel source ``y`` into the (m, p)
+    container — container[i, j] = y_flat[i * p + j] — and run the
+    cascade. For a row-packed pair, ``y`` is the row-wise merge of the
+    host view (below the trial's guest base) and the guest view
+    (placed AT the base): every barrel read of a live row stays inside
+    its own region (drift <= distance to the region floor whenever the
+    selecting bit is set), so ONE barrel packs both trials."""
     pw = load_tab(0, PW)
     rphase = pw & ((1 << PK_R_BITS) - 1)
     sdrift = (pw >> PK_S_SHIFT) & ((1 << PK_S_BITS) - 1)
@@ -479,31 +561,139 @@ def _fused_kernel(stagevec, scal, coef, wire_hbm, scales_hbm, tab_hbm,
     A[:] = xpk
     _cascade_body(scal, coef, lambda lev: load_tab(1 + lev, P), out_ref,
                   A, Bs, b, p, L=L, NL=NL, rows=rows, P=P, RS=RS,
-                  widths=widths, nspread=nspread, pbits=pbits)
+                  widths=widths, nspread=nspread, pbits=pbits,
+                  paired=paired)
+
+
+def _fused_kernel_paired(stagevec, scal, coef, wire_hbm, gwire_hbm,
+                         scales_hbm, tab_hbm, out_ref, A, Bs, T, WB, SC,
+                         WG, SG, semt, semw, sems, semw2, sems2,
+                         *, mode, L, NL, rows, P, RS, widths, nspread,
+                         pbits, sbits, resident, PW, gext):
+    """Row-packed variant of :func:`_fused_kernel`: ONE program serves
+    the host stage's trial AND a guest stage's same-p trial riding in
+    the host container's dead rows. The guest stage's wire slice (a
+    second shipped part; stagevec slots 4..7) streams into its own
+    scratch, decodes identically, and is row-merged into the pack
+    barrel source at the trial's guest base — the barrels, merge tree
+    and S/N then run ONCE over the combined per-row tables."""
+    b = pl.program_id(0)
+    d = pl.program_id(1)
+    p = scal[b, 0]
+    pr = stagevec[0, 1]
+    soff = stagevec[0, 2]
+    r0 = stagevec[0, 3]
+    prg = stagevec[0, 5]
+    gsoff = stagevec[0, 6]
+    gr0 = stagevec[0, 7]
+    group, planes = WIRE_MODES[mode]
+    PR = _prcap(rows, group)
+    R0C = group * PR
+    NCH = PR // DMA_CHUNK
+    PRG = _prcap(gext, group)
+    R0G = group * PRG
+    NCHG = PRG // DMA_CHUNK
+
+    cps = pltpu.make_async_copy(
+        scales_hbm.at[d, pl.ds(soff, R0C)], SC, sems
+    )
+    cps.start()
+    cps2 = pltpu.make_async_copy(
+        scales_hbm.at[d, pl.ds(gsoff, R0G)], SG, sems2
+    )
+    cps2.start()
+
+    for pi in range(planes):
+        for c in range(NCH):
+            @pl.when(c * DMA_CHUNK < pr)
+            def _start(pi=pi, c=c):
+                _wire_chunk_copy(stagevec, 0, wire_hbm, WB, semw, d,
+                                 pi, c).start()
+        for c in range(NCHG):
+            @pl.when(c * DMA_CHUNK < prg)
+            def _gstart(pi=pi, c=c):
+                _wire_chunk_copy(stagevec, 4, gwire_hbm, WG, semw2, d,
+                                 pi, c).start()
+
+    load_tab = _make_load_tab(tab_hbm, T, semt, b, d, resident)
+
+    # Pallas async-copy semaphore waits (DMA completion inside the
+    # kernel body), not thread waits — no timeout API exists.
+    for pi in range(planes):
+        for c in range(NCH):
+            @pl.when(c * DMA_CHUNK < pr)
+            def _wait(pi=pi, c=c):
+                _wire_chunk_copy(  # riplint: disable=RIP004
+                    stagevec, 0, wire_hbm, WB, semw, d, pi, c).wait()
+        for c in range(NCHG):
+            @pl.when(c * DMA_CHUNK < prg)
+            def _gwait(pi=pi, c=c):
+                _wire_chunk_copy(  # riplint: disable=RIP004
+                    stagevec, 4, gwire_hbm, WG, semw2, d, pi, c).wait()
+    cps.wait()
+    cps2.wait()  # riplint: disable=RIP004
+
+    x = _decode_planes(WB, SC, r0, mode=mode, R0C=R0C, PW=PW)
+    y = x[:rows]
+    xg = _decode_planes(WG, SG, gr0, mode=mode, R0C=R0G, PW=PW)
+    # Place the guest view AT the trial's guest base: pad its rows to
+    # the container height, roll down by the (per-trial, SMEM) base and
+    # row-select. Rows below the base keep the host view; the roll's
+    # wrapped rows land only there and are therefore never read.
+    if R0G >= rows:
+        ygf = xg[:rows]
+    else:
+        ygf = jnp.concatenate(
+            [xg, jnp.zeros((rows - R0G, PW), jnp.float32)], axis=0)
+    gb = scal[b, 1]  # guest base row; == rows for a guestless trial
+    rolled = pltpu.roll(ygf, gb, axis=0)
+    rowi = jax.lax.broadcasted_iota(jnp.int32, (rows, PW), 0)
+    y = jnp.where(rowi >= gb, rolled, y)
+    _pack_and_cascade(scal, coef, load_tab, out_ref, A, Bs, b, p, y,
+                      L=L, NL=NL, rows=rows, P=P, RS=RS, widths=widths,
+                      nspread=nspread, pbits=pbits, sbits=sbits, PW=PW,
+                      paired=True)
 
 
 def _pack_scal(tables, rows):
-    """(B, 32) int32 scalar bank for one bucket's problems."""
+    """(B, SCAL_SLOTS) int32 scalar bank for one bucket's problems.
+    Tables from :func:`slottables.combine_tables` (row-packed pairs)
+    fill the guest half: [1] = guest base row (``rows`` marks a
+    guestless trial so the kernel's guest row masks come up empty) and
+    [32+3j..34+3j] = the guest's three spread-roll amounts per step."""
     B = len(tables)
-    scal = np.zeros((B, 32), np.int32)
+    scal = np.zeros((B, SCAL_SLOTS), np.int32)
     for i, t in enumerate(tables):
         scal[i, 0] = t.p
-        # P - p is implied by the kernel's static P; slot [1] kept for
-        # debugging only.
+        gbase = getattr(t, "gbase", 0)
+        scal[i, 1] = gbase if gbase else rows
         for j, A in enumerate(t.spread):
             half = rows >> (j + 1)
             scal[i, 2 + 2 * j] = (half - A) % rows
             scal[i, 3 + 2 * j] = (half - A - 1) % rows
+        if gbase:
+            for j, (Ag, aj, an) in enumerate(t.gspread):
+                half = rows >> (j + 1)
+                scal[i, 32 + 3 * j] = (an - aj) % rows
+                scal[i, 33 + 3 * j] = (an - aj + half - Ag) % rows
+                scal[i, 34 + 3 * j] = (an - aj + half - Ag - 1) % rows
     return scal
 
 
-def _pack_coef(ps, widths, hcoef, bcoef, stdnoise):
-    """(B, 32) f32 coefficient bank: (h+b)/std then b/std."""
+def _pack_coef(ps, widths, hcoef, bcoef, stdnoise, ghcoef=None,
+               gbcoef=None, gstdnoise=None):
+    """(B, COEF_SLOTS) f32 coefficient bank: (h+b)/std then b/std in
+    the first two NWPAD blocks; a row-packed bucket's guest trials fill
+    the third and fourth (same layout, the guest's normalisation)."""
     B = len(ps)
     nw = len(widths)
-    coef = np.zeros((B, 32), np.float32)
+    coef = np.zeros((B, COEF_SLOTS), np.float32)
     coef[:, :nw] = (hcoef + bcoef) / stdnoise[:, None]
     coef[:, NWPAD : NWPAD + nw] = bcoef / stdnoise[:, None]
+    if gstdnoise is not None:
+        coef[:, 2 * NWPAD : 2 * NWPAD + nw] = (
+            (ghcoef + gbcoef) / gstdnoise[:, None])
+        coef[:, 3 * NWPAD : 3 * NWPAD + nw] = gbcoef / gstdnoise[:, None]
     return coef
 
 
@@ -532,7 +722,12 @@ def _pack_coef(ps, widths, hcoef, bcoef, stdnoise):
 # v6: fused wire->kernel stages (decode + dequant + pack moved into the
 # kernel prologue, pack-word table prepended at index 0), natural-level
 # head-chain trim to the provable 2^(l-1) drift bound.
-KERNEL_CACHE_VERSION = 6
+# v7: row-packed containers (a second same-p bins-trial embedded in the
+# dead rows via per-row table indirection: guest spread selects 3..5,
+# guest halves of the SMEM banks — scal widened to 64 slots, coef to
+# 4 * NWPAD — paired fused/two-dispatch kernel bodies) and the odd-slot
+# container forms 5/7 * 2^(L-3).
+KERNEL_CACHE_VERSION = 7
 
 
 def _hash_code_object(h, code):
@@ -567,10 +762,14 @@ def kernel_code_digest():
     from . import slottables
 
     h = hashlib.sha1()
-    for fn in (_kernel, _fused_kernel, _cascade_body, _make_load_tab,
+    for fn in (_kernel, _fused_kernel, _fused_kernel_paired,
+               _pack_and_cascade, _decode_planes, _wire_chunk_copy,
+               _cascade_body, _make_load_tab,
                pack_gather_words, _pack_scal, _pack_coef,
                slottables.pack_word, slottables.build_tables,
-               slottables._merge_tables, slottables.container_rows):
+               slottables.combine_tables, slottables.guest_base,
+               slottables._merge_tables, slottables.container_rows,
+               slottables.container_forms):
         h.update(fn.__name__.encode())
         _hash_code_object(h, fn.__code__)
     return h.hexdigest()
@@ -656,11 +855,13 @@ class _CachedCall:
 
 
 @functools.lru_cache(maxsize=64)
-def _build_call(L, NL, rows, P, RS, widths, nspread, pbits, D, B, interpret):
+def _build_call(L, NL, rows, P, RS, widths, nspread, pbits, D, B,
+                interpret, paired=False):
     resident = tables_resident(L, NL, rows, P)
     kern = functools.partial(
         _kernel, L=L, NL=NL, rows=rows, P=P, RS=RS,
         widths=widths, nspread=nspread, pbits=pbits, resident=resident,
+        paired=paired,
     )
     ntab = num_level_tables(L, NL)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -698,10 +899,11 @@ def _build_call(L, NL, rows, P, RS, widths, nspread, pbits, D, B, interpret):
     jitted = jax.jit(call)
     if interpret:
         return jitted
-    key = (L, NL, rows, P, RS, widths, nspread, pbits, D, B, resident)
+    key = (L, NL, rows, P, RS, widths, nspread, pbits, D, B, resident,
+           paired)
     arg_shapes = (
-        ((B, 32), jnp.int32),
-        ((B, 32), jnp.float32),
+        ((B, SCAL_SLOTS), jnp.int32),
+        ((B, COEF_SLOTS), jnp.float32),
         ((D, B, rows, P), jnp.float32),
         ((B, ntab, rows, 128), jnp.int32),
     )
@@ -710,48 +912,75 @@ def _build_call(L, NL, rows, P, RS, widths, nspread, pbits, D, B, interpret):
 
 @functools.lru_cache(maxsize=128)
 def _build_fused_call(mode, L, NL, rows, P, RS, widths, nspread, pbits,
-                      sbits, D, B, PW, wrows, srows, interpret):
+                      sbits, D, B, PW, wrows, srows, interpret,
+                      gext=None, gwrows=None):
     """Compiled fused wire->container->FFA->S/N program (one device
     dispatch per cascade stage). Keyed like :func:`_build_call` plus the
     wire mode, plan view width and the shipped wire/scale row counts
     (the last two only retrace, never re-bucket — the kernel body and
     scratch shapes depend on (mode, rows, P, PW) alone, so stages
-    sharing a shape bucket share one Mosaic build exactly as before)."""
-    resident = tables_resident(L, NL, rows, P, fused_mode=mode, PW=PW)
+    sharing a shape bucket share one Mosaic build exactly as before).
+    ``gext``/``gwrows`` (row-packed pairs) select the paired kernel: a
+    second wire-part operand of ``gwrows`` rows and a guest decode
+    scratch sized for ``gext`` container rows."""
+    paired = gext is not None
+    resident = tables_resident(L, NL, rows, P, fused_mode=mode, PW=PW,
+                               gext=gext)
     group, planes = WIRE_MODES[mode]
     PR = _prcap(rows, group)
-    kern = functools.partial(
-        _fused_kernel, mode=mode, L=L, NL=NL, rows=rows, P=P, RS=RS,
-        widths=widths, nspread=nspread, pbits=pbits, sbits=sbits,
-        resident=resident, PW=PW,
-    )
+    if paired:
+        kern = functools.partial(
+            _fused_kernel_paired, mode=mode, L=L, NL=NL, rows=rows, P=P,
+            RS=RS, widths=widths, nspread=nspread, pbits=pbits,
+            sbits=sbits, resident=resident, PW=PW, gext=gext,
+        )
+    else:
+        kern = functools.partial(
+            _fused_kernel, mode=mode, L=L, NL=NL, rows=rows, P=P, RS=RS,
+            widths=widths, nspread=nspread, pbits=pbits, sbits=sbits,
+            resident=resident, PW=PW,
+        )
     ntab = num_level_tables(L, NL) + 1  # + the pack-word table (index 0)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),   # stage vector (1, 8)
+        pl.BlockSpec(memory_space=pltpu.SMEM),   # scal (B, SCAL_SLOTS)
+        pl.BlockSpec(memory_space=pltpu.SMEM),   # coef (B, COEF_SLOTS)
+        pl.BlockSpec(memory_space=pl.ANY),       # wire (D, wrows, PW)
+        pl.BlockSpec(memory_space=pl.ANY),       # scales (D, srows, 1)
+        pl.BlockSpec(memory_space=pl.ANY),       # tables
+    ]
+    scratch = [
+        pltpu.VMEM((rows, P), jnp.float32),
+        pltpu.VMEM((rows, P), jnp.float32),
+        pltpu.VMEM((ntab, rows, 128) if resident else (rows, 128),
+                   jnp.int32),
+        pltpu.VMEM((planes, PR, PW), jnp.uint8),
+        pltpu.VMEM((group * PR, 1), jnp.float32),
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA((planes, PR // DMA_CHUNK)),
+        pltpu.SemaphoreType.DMA,
+    ]
+    if paired:
+        PRG = _prcap(gext, group)
+        # guest wire part after the host's (stagevec slots 4..7)
+        in_specs.insert(4, pl.BlockSpec(memory_space=pl.ANY))
+        scratch[5:5] = [
+            pltpu.VMEM((planes, PRG, PW), jnp.uint8),     # WG
+            pltpu.VMEM((group * PRG, 1), jnp.float32),    # SG
+        ]
+        scratch += [
+            pltpu.SemaphoreType.DMA((planes, PRG // DMA_CHUNK)),  # semw2
+            pltpu.SemaphoreType.DMA,                              # sems2
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
         grid=(B, D),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),   # stage vector (1, 8)
-            pl.BlockSpec(memory_space=pltpu.SMEM),   # scal (B, 32)
-            pl.BlockSpec(memory_space=pltpu.SMEM),   # coef (B, 32)
-            pl.BlockSpec(memory_space=pl.ANY),       # wire (D, wrows, PW)
-            pl.BlockSpec(memory_space=pl.ANY),       # scales (D, srows, 1)
-            pl.BlockSpec(memory_space=pl.ANY),       # tables
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, RS, 128), lambda b, d: (d, b, 0, 0),
             memory_space=pltpu.VMEM,
         ),
-        scratch_shapes=[
-            pltpu.VMEM((rows, P), jnp.float32),
-            pltpu.VMEM((rows, P), jnp.float32),
-            pltpu.VMEM((ntab, rows, 128) if resident else (rows, 128),
-                       jnp.int32),
-            pltpu.VMEM((planes, PR, PW), jnp.uint8),
-            pltpu.VMEM((group * PR, 1), jnp.float32),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA((planes, PR // DMA_CHUNK)),
-            pltpu.SemaphoreType.DMA,
-        ],
+        scratch_shapes=scratch,
     )
     call = pl.pallas_call(
         kern,
@@ -764,16 +993,34 @@ def _build_fused_call(mode, L, NL, rows, P, RS, widths, nspread, pbits,
     if interpret:
         return jitted
     key = ("fused", mode, L, NL, rows, P, RS, widths, nspread, pbits,
-           sbits, D, B, PW, wrows, srows, resident)
-    arg_shapes = (
+           sbits, D, B, PW, wrows, srows, resident, gext, gwrows)
+    arg_shapes = [
         ((1, 8), jnp.int32),
-        ((B, 32), jnp.int32),
-        ((B, 32), jnp.float32),
+        ((B, SCAL_SLOTS), jnp.int32),
+        ((B, COEF_SLOTS), jnp.float32),
         ((D, wrows, PW), jnp.uint8),
         ((D, srows, 1), jnp.float32),
         ((B, ntab, rows, 128), jnp.int32),
-    )
-    return _CachedCall(key, jitted, arg_shapes)
+    ]
+    if paired:
+        arg_shapes.insert(4, ((D, gwrows, PW), jnp.uint8))
+    return _CachedCall(key, jitted, tuple(arg_shapes))
+
+
+def bucket_rows(ms, L):
+    """Container height for a bucket of problems ``ms`` at depth L
+    under the live container-family flags: 2**L only when
+    RIPTIDE_KERNEL_BASE3=0, the {2**L, 3 * 2**(L-2)} family otherwise,
+    plus the odd-slot 5/7 * 2**(L-3) forms when the row-pack layout is
+    on. THE single source of the flag->family mapping — CycleKernel and
+    the engine's eligibility/occupancy models all derive from it."""
+    from .slottables import container_rows
+
+    if not envflags.get("RIPTIDE_KERNEL_BASE3"):
+        return 1 << L
+    return container_rows(max(ms), L,
+                          extended=bool(envflags.get(
+                              "RIPTIDE_KERNEL_ROW_PACK")))
 
 
 class CycleKernel:
@@ -786,10 +1033,16 @@ class CycleKernel:
     hcoef, bcoef : (B, NW) float arrays
     stdnoise : (B,) float
     L : bucket depth (>= max over ceil(log2 m))
+    guests : optional row-pack spec — a second stage's same-p trials
+        riding in this bucket's dead container rows: dict with ``ms``
+        (per-trial guest row counts), ``bases`` (per-trial guest base
+        row or None for no guest on that trial), ``hcoef``/``bcoef``/
+        ``stdnoise`` (the guest trials' own normalisation). Bases must
+        be feasible per :func:`slottables.guest_base`.
     """
 
     def __init__(self, ms, ps, widths, hcoef, bcoef, stdnoise, L=None,
-                 interpret=False):
+                 interpret=False, guests=None):
         ms = [int(m) for m in ms]
         ps = [int(p) for p in ps]
         widths = tuple(int(w) for w in widths)
@@ -810,18 +1063,11 @@ class CycleKernel:
         if len(widths) > NWPAD:
             raise ValueError(f"at most {NWPAD} trial widths supported")
         from .plan import num_levels
-        from .slottables import container_rows
 
         Lmin = max(num_levels(m) for m in ms)
         self.L = L = Lmin if L is None else max(int(L), Lmin)
         self.NL = NL = min(L, NAT_LEVELS)
-        # Base-3 (1.5 * 2**k) containers serve buckets whose largest
-        # problem fits, cutting the power-of-two padding waste by ~25%
-        # on affected stages; RIPTIDE_KERNEL_BASE3=0 forces 2**L.
-        if not envflags.get("RIPTIDE_KERNEL_BASE3"):
-            rows = 1 << L
-        else:
-            rows = container_rows(max(ms), L)
+        rows = bucket_rows(ms, L)
         self.rows = rows
         pmax = max(ps)
         self.P = P = ((pmax + 127) // 128) * 128
@@ -837,8 +1083,41 @@ class CycleKernel:
         self.widths = widths
         self.B = B = len(ms)
         self.nspread = L - NL
+        # Guest spread-roll slots end at 32 + 3 * nspread - 1 < 64.
+        assert self.nspread <= 10, (L, NL)
 
-        tabs = [build_tables(m, p, L, R=rows) for m, p in zip(ms, ps)]
+        self.guest_ms = None
+        self.guest_bases = None
+        self.gext = None
+        if guests is not None:
+            gms = [int(m) for m in guests["ms"]]
+            bases = [None if bb is None else int(bb)
+                     for bb in guests["bases"]]
+            assert len(gms) == len(bases) == B
+            from .slottables import guest_base as _gbase
+
+            for m, p, gm, bb in zip(ms, ps, gms, bases):
+                if bb is None:
+                    continue
+                lo = _gbase(m, gm, L, rows)
+                assert lo is not None and bb >= lo and bb + gm <= rows, (
+                    m, gm, L, rows, bb)
+            self.guest_ms = gms
+            self.guest_bases = bases
+            exts = [rows - bb for bb in bases if bb is not None]
+            # Guest wire scratch extent (static): at least one DMA
+            # chunk's worth so an all-dummy-guest bucket still builds.
+            self.gext = max(exts) if exts else DMA_CHUNK
+        self.paired = guests is not None
+
+        tabs = []
+        for i, (m, p) in enumerate(zip(ms, ps)):
+            t = build_tables(m, p, L, R=rows)
+            if self.paired and self.guest_bases[i] is not None:
+                tg = build_tables(self.guest_ms[i], p, L, R=rows,
+                                  base=self.guest_bases[i])
+                t = combine_tables(t, tg)
+            tabs.append(t)
         T = NL + 2 * (L - NL)
         words = np.zeros((B, T, rows), np.int32)
         for i, t in enumerate(tabs):
@@ -850,8 +1129,16 @@ class CycleKernel:
         self.ms = ms
         self.ps = ps
         self.scal = _pack_scal(tabs, rows)
-        self.coef = _pack_coef(ps, widths, np.asarray(hcoef),
-                               np.asarray(bcoef), np.asarray(stdnoise))
+        if self.paired:
+            self.coef = _pack_coef(
+                ps, widths, np.asarray(hcoef), np.asarray(bcoef),
+                np.asarray(stdnoise), np.asarray(guests["hcoef"]),
+                np.asarray(guests["bcoef"]),
+                np.asarray(guests["stdnoise"]))
+        else:
+            self.coef = _pack_coef(ps, widths, np.asarray(hcoef),
+                                   np.asarray(bcoef),
+                                   np.asarray(stdnoise))
         self.interpret = bool(interpret)
         self._dev = None
         self._dev_fused = {}
@@ -874,7 +1161,7 @@ class CycleKernel:
         ``D``; see :class:`_CachedCall`."""
         return _build_call(self.L, self.NL, self.rows, self.P, self.RS,
                            self.widths, self.nspread, self.pbits,
-                           D, self.B, self.interpret)
+                           D, self.B, self.interpret, self.paired)
 
     # -- fused single-dispatch path --------------------------------------
 
@@ -892,7 +1179,13 @@ class CycleKernel:
         index 0, lane-replicated on device like the level words."""
         dev = self._dev_fused.get(PW)
         if dev is None:
-            pack = pack_gather_words(self.ms, self.ps, self.rows, PW)
+            guests = None
+            if self.paired:
+                guests = [None if bb is None else (gm, bb)
+                          for gm, bb in zip(self.guest_ms,
+                                            self.guest_bases)]
+            pack = pack_gather_words(self.ms, self.ps, self.rows, PW,
+                                     guests=guests)
             words = np.concatenate([pack[:, None], self.words], axis=1)
             w = jnp.asarray(words)
             wrep = jnp.broadcast_to(w[..., None], w.shape + (128,))
@@ -903,26 +1196,39 @@ class CycleKernel:
             )
         return dev
 
-    def build_fused(self, D, mode, PW, wrows, srows):
+    def build_fused(self, D, mode, PW, wrows, srows, gwrows=None):
         """The compiled fused wire->FFA->S/N call (one device dispatch
         per stage) for a DM-batch of ``D`` reading a shipped
-        (D, wrows, PW) wire part and (D, srows, 1) scale view."""
+        (D, wrows, PW) wire part and (D, srows, 1) scale view; a
+        row-packed bucket also reads its guest stage's (D, gwrows, PW)
+        part."""
         return _build_fused_call(mode, self.L, self.NL, self.rows, self.P,
                                  self.RS, self.widths, self.nspread,
                                  self.pbits, self._sbits(PW), D, self.B,
-                                 PW, wrows, srows, self.interpret)
+                                 PW, wrows, srows, self.interpret,
+                                 self.gext if self.paired else None,
+                                 gwrows if self.paired else None)
 
-    def run_fused(self, stagevec, wire_dev, scales_dev, mode):
+    def run_fused(self, stagevec, wire_dev, scales_dev, mode,
+                  gwire_dev=None):
         """Queue the fused single-dispatch program: ``stagevec`` is the
         (1, 8) int32 stage vector [wire row offset, plane rows, scale
-        row offset, view rows, 0...]; returns (D, B, RS, 128) f32."""
+        row offset, view rows, then the guest stage's four or 0s];
+        returns (D, B, RS, 128) f32. A paired bucket passes the guest
+        stage's shipped wire part as ``gwire_dev``."""
         PW = int(wire_dev.shape[2])
         scal, coef, wrep = self._operands_fused(PW)
+        assert (gwire_dev is not None) == self.paired
         call = self.build_fused(int(wire_dev.shape[0]), mode, PW,
                                 int(wire_dev.shape[1]),
-                                int(scales_dev.shape[1]))
+                                int(scales_dev.shape[1]),
+                                int(gwire_dev.shape[1])
+                                if self.paired else None)
         if isinstance(wire_dev, jax.core.Tracer) and hasattr(call, "jitted"):
             call = call.jitted  # inside an outer trace (see __call__)
+        if self.paired:
+            return call(stagevec, scal, coef, wire_dev, gwire_dev,
+                        scales_dev, wrep)
         return call(stagevec, scal, coef, wire_dev, scales_dev, wrep)
 
     def __call__(self, x):

@@ -29,7 +29,36 @@ import numpy as np
 from .reference import _merge_mapping
 
 __all__ = ["ffa_plan", "FFAPlan", "batch_plans", "num_levels",
-           "CONTRACT_PLANS", "contract_plan_params"]
+           "pair_bucket_bases", "CONTRACT_PLANS", "contract_plan_params"]
+
+
+def pair_bucket_bases(ms_host, ms_guest, L, rows, skip=()):
+    """Which trials of a guest bucket co-habit a host bucket's
+    containers: per-trial guest base rows for embedding guest trial j
+    (``ms_guest[j]`` rows) into host trial j's ``rows``-row container
+    at depth ``L``, or None if ANY needed trial has no feasible base.
+
+    ``skip`` marks trial positions that never need embedding (padding
+    dummies / zero evaluated rows) — they get a None base, which the
+    kernel turns into an empty guest row mask. Same-position pairing
+    keeps p equal per container, which is what lets the paired kernel
+    share every per-program scalar (wrap roll, column mask, widths)
+    between the two trials.
+    """
+    from .slottables import guest_base
+
+    bases = []
+    for j, (mh, mg) in enumerate(zip(ms_host, ms_guest)):
+        if j in skip:
+            bases.append(None)
+            continue
+        gb = guest_base(mh, mg, L, rows)
+        if gb is None:
+            return None
+        bases.append(gb)
+    if not any(b is not None for b in bases):
+        return None
+    return tuple(bases)
 
 
 # Representative search-plan parameter sets the semantic static pass

@@ -37,26 +37,75 @@ from .slotffa import node_sizes
 from .plan import num_levels
 
 __all__ = [
-    "KernelTables", "build_tables", "simulate_dense", "container_rows",
-    "NAT_LEVELS", "SLOT_S",
+    "KernelTables", "build_tables", "combine_tables", "simulate_dense",
+    "simulate_dense_pair", "container_rows", "container_forms",
+    "guest_base", "NAT_LEVELS", "SLOT_S",
 ]
 
 NAT_LEVELS = 3      # levels executed in natural layout
 SLOT_S = 8          # slot size after the spread (2**NAT_LEVELS)
 
 
-def container_rows(m, L):
+def container_forms(L, extended=False):
+    """Legal container heights at bucket depth L, ascending. The base
+    family is {2**L, 3 * 2**(L-2)}; ``extended`` adds the odd-slot
+    forms 5 * 2**(L-3) and 7 * 2**(L-3) (row-pack layout): the spread
+    still halves group sizes down to the final slot, and the final
+    slot itself is never halved, so an ODD slot size (5, 7) is legal —
+    the interleaved row-doubling's floor division absorbs it. Odd-slot
+    forms need L >= 6 to stay a multiple of the 8-row sublane tile."""
+    forms = []
+    if extended and L >= 6:
+        forms.append(5 << (L - 3))
+    if L >= 5:
+        forms.append(3 << (L - 2))
+    if extended and L >= 6:
+        forms.append(7 << (L - 3))
+    forms.append(1 << L)
+    return forms
+
+
+def container_rows(m, L, extended=False):
     """Container height for an m-row problem at bucket depth L: the
-    smaller of 2**L and 1.5 * 2**(L-1) = 3 * 2**(L-2) that still holds
-    m rows. The base-3 container cuts the ~1.44x average power-of-two
-    padding waste to ~1.19x; slot sizes become 3 * 2**j, which every
-    phase below supports (row-doubling only needs EVEN slot sizes, and
-    the spread/natural phases are container-size agnostic). Base-3 is
-    used only for L >= 5 so the container stays a multiple of the 8-row
-    sublane tile (3 * 2**(L-2) % 8 == 0 needs L >= 5)."""
-    if L >= 5 and 3 << (L - 2) >= m:
-        return 3 << (L - 2)
+    smallest legal form of :func:`container_forms` holding m rows. The
+    base-3 container cuts the ~1.44x average power-of-two padding
+    waste to ~1.19x; the ``extended`` (row-pack) family's 1.25x-family
+    steps cut it to ~1.10x. Slot sizes become s * 2**j for s in
+    {5, 6, 7, 8}, which every phase below supports (row-doubling only
+    needs EVEN slot sizes above the final slot, and the spread/natural
+    phases are container-size agnostic). Non-2**L forms are gated on L
+    so the container stays a multiple of the 8-row sublane tile."""
+    for rows in container_forms(L, extended):
+        if rows >= m:
+            return rows
     return 1 << L
+
+
+def guest_base(m_host, m_guest, L, rows):
+    """Smallest base row at which an m_guest-row problem can co-habit
+    an m_host-row problem's ``rows`` container at depth L, or None.
+
+    The guest's depth-d tree nodes sit at offset ``base >> d`` inside
+    the depth-d slots (the floor chain: the interleaved row-doubling's
+    (s + delta) // 2 read absorbs odd offsets with the STANDARD delta
+    tables, see build_tables). Feasibility per depth d is therefore a
+    per-slot capacity check between the two fixed canonical trees:
+    host's largest depth-d node must fit below the guest offset, and
+    the offset plus the guest's largest node inside the slot."""
+    m_host, m_guest, rows = int(m_host), int(m_guest), int(rows)
+    if m_host < 1 or m_guest < 1:
+        return None
+    NL = min(L, NAT_LEVELS)
+    D0 = L - NL
+    base = m_host
+    for d in range(D0 + 1):
+        base = max(base, int(node_sizes(m_host, d).max()) << d)
+    if base + m_guest > rows:
+        return None
+    for d in range(D0 + 1):
+        if (base >> d) + int(node_sizes(m_guest, d).max()) > (rows >> d):
+            return None
+    return base
 
 # packed word layout (int32):
 #   bits 0-10  sigma mod p            (lane roll;  < p <= 2047)
@@ -95,6 +144,10 @@ class KernelTables:
         head size is the larger candidate (mh == A+1).
     spread_sizes : list over steps of ((groups,) head-size-A, child rows)
     slot_words : (L - NL, rows) int64 -- packed words for slot levels.
+    base : guest base row (0 for a container-owning host problem).
+    gspread : guest problems only -- per spread step (A, alpha_j,
+        alpha_{j+1}): the guest head-child size candidate floor and the
+        step's in-slot offsets ``base >> j`` / ``base >> (j+1)``.
     """
 
 
@@ -103,10 +156,18 @@ def _merge_tables(mn):
     return _merge_mapping(mn)
 
 
-def build_tables(m, p, L=None, R=None):
+def build_tables(m, p, L=None, R=None, base=0):
     """Build all kernel tables for one (m, p) problem at bucket depth L
-    in a container of ``R`` rows (2**L, or 3 * 2**(L-2) — see
-    :func:`container_rows`; default 2**L)."""
+    in a container of ``R`` rows (a :func:`container_forms` member;
+    default 2**L). ``base`` > 0 builds the GUEST placement of a
+    row-packed pair: the problem's depth-d tree nodes sit at offset
+    ``base >> d`` inside the depth-d slots (natural phase contiguous at
+    ``base``), with spread selects 3..5 instead of the host's 0..2.
+    The floor chain base >> d needs NO divisibility: the interleaved
+    row-doubling reads ``(s + delta) // 2``, so an odd parent offset
+    (base >> d = 2 * (base >> (d+1)) + 1) lands on the same child row
+    with the STANDARD delta tables. Feasibility (no collision with a
+    base-0 host of a given m) is :func:`guest_base`'s contract."""
     m, p = int(m), int(p)
     if not 0 < p <= PH_MASK:
         # sigma/thr live in PH_BITS-wide packed fields and the kernel's
@@ -120,14 +181,17 @@ def build_tables(m, p, L=None, R=None):
     assert L >= Lmin
     NL = min(L, NAT_LEVELS)
     rows = (1 << L) if R is None else int(R)
-    # Base-3 containers require L >= 5, matching container_rows: below
-    # that the container is not a multiple of the 8-row sublane tile and
-    # the spread/slot group halves come out odd — tables would build but
-    # the device path cannot serve them.
-    legal = (1 << L,) + ((3 << (L - 2),) if L >= 5 else ())
+    # Non-2**L containers require a minimum L, matching container_forms:
+    # below that the container is not a multiple of the 8-row sublane
+    # tile and the spread/slot group halves come out odd — tables would
+    # build but the device path cannot serve them.
+    legal = tuple(container_forms(L, extended=True))
     assert rows >= m and rows in legal, (m, L, rows)
+    base = int(base)
+    assert 0 <= base and base + m <= rows, (m, base, rows)
     t = KernelTables()
     t.m, t.p, t.L, t.NL, t.rows = m, p, L, NL, rows
+    t.base = base
 
     # ---- natural phase -------------------------------------------------
     # Level l (1..NL) merges depth d+1 = L-l+1 children into depth d
@@ -142,9 +206,8 @@ def build_tables(m, p, L=None, R=None):
         sizes = node_sizes(m, d)
         csizes = node_sizes(m, d + 1)
         # dtype already int64 (node_sizes); left implicit because this
-        # body is covered by the KERNEL_CACHE_VERSION bytecode digest
-        # and a no-op edit must not force a cache-version bump.
-        r0 = np.concatenate(([0], np.cumsum(sizes)[:-1]))  # riplint: disable=RIP002
+        # body is covered by the KERNEL_CACHE_VERSION bytecode digest.
+        r0 = base + np.concatenate(([0], np.cumsum(sizes)[:-1]))  # riplint: disable=RIP002
         sig = np.zeros(rows, np.int64)
         dh = np.zeros(rows, np.int64)
         bb = np.zeros(rows, np.int64)
@@ -153,8 +216,8 @@ def build_tables(m, p, L=None, R=None):
             mn = int(sizes[k])
             if mn == 0:
                 continue
-            base = int(r0[k])
-            val[base : base + mn] = True
+            r0k = int(r0[k])
+            val[r0k : r0k + mn] = True
             if mn == 1:
                 # lone row carries itself: head read self, no tail.
                 # dh = 0; mark tail invalid via sigma/thr: we encode
@@ -162,15 +225,15 @@ def build_tables(m, p, L=None, R=None):
                 # offset o chosen to read row itself with sigma=0 and
                 # head reads ZERO... Simpler: head = self (dh = 0),
                 # tail weight zero: set B to the sentinel 2**B_BITS - 1.
-                bb[base] = (1 << B_BITS) - 1
+                bb[r0k] = (1 << B_BITS) - 1
                 continue
             mh = int(csizes[2 * k])
             h, tt, sh = _merge_tables(mn)
             s = np.arange(mn)
-            dh[base : base + mn] = s - h
+            dh[r0k : r0k + mn] = s - h
             o = mh + tt - s                      # tail read offset
-            bb[base : base + mn] = o + 1         # in [0, 2**(l-1) + 1]
-            sig[base : base + mn] = sh
+            bb[r0k : r0k + mn] = o + 1           # in [0, 2**(l-1) + 1]
+            sig[r0k : r0k + mn] = sh
             # Head drift is bounded by the tail child size: h(s) =
             # round(kh*s) >= kh*s - 1/2 gives s - h <= s*mt/(mn-1) + 1/2
             # <= mt <= 2^(l-1). The kernel's head select chain stops at
@@ -193,9 +256,14 @@ def build_tables(m, p, L=None, R=None):
     # output layout, in-slot index i) reads input flat row
     #   g*S + (child ? mh(g) + i : i)  =  u + child*(mh(g) - half),
     # i.e. one of THREE static row offsets {0, A - half, A + 1 - half}.
-    # Per-row word: bits 22-23 select the candidate (0 head, 1 tail with
-    # mh = A, 2 tail with mh = A + 1); sign bit = row valid.
+    # Per-row word: bits 22-24 select the candidate (0 head, 1 tail with
+    # mh = A, 2 tail with mh = A + 1); sign bit = row valid. A GUEST
+    # placement (base > 0) keeps its depth-j block at in-slot offset
+    # alpha_j = base >> j, so its three candidates gain the constant
+    # alpha_j - alpha_{j+1} and select as 3..5 (amounts live in the
+    # paired kernel's per-trial scalar bank, like the host's).
     spread = []
+    gspread = []
     spread_words = np.zeros((max(L - NL, 0), rows), np.int32)
     for j in range(L - NL):
         sizes = node_sizes(m, j)
@@ -204,9 +272,10 @@ def build_tables(m, p, L=None, R=None):
         hi = (mh > A).astype(np.int64)
         assert int(mh.max()) <= A + 1
         spread.append(A)
+        gspread.append((A, base >> j, base >> (j + 1)))
         # Group size at step j is rows >> j (a multiple of 2 while
-        # j <= L - NL - 1 for both container forms); plain division
-        # rather than bit tricks so base-3 rows work too.
+        # j <= L - NL - 1 for every container form); plain division
+        # rather than bit tricks so non-2**L rows work too.
         half = rows >> (j + 1)
         iota = np.arange(rows)
         g = iota // (rows >> j)         # parent group
@@ -214,10 +283,18 @@ def build_tables(m, p, L=None, R=None):
         i = iota % half
         mh_g = mh[g]
         cnt = np.where(child == 0, mh_g, sizes[g] - mh_g)
-        sel = np.where(child == 0, 0, 1 + hi[g])
+        if base:
+            an = base >> (j + 1)
+            assert an + int(cnt.max()) <= half, (m, j, base, rows)
+            sel = np.where(child == 0, 3, 4 + hi[g])
+            val = (i >= an) & (i < an + cnt)
+        else:
+            sel = np.where(child == 0, 0, 1 + hi[g])
+            val = i < cnt
         w = sel << 22
-        spread_words[j] = np.where(i < cnt, w | (1 << 31), w).astype(np.int64).astype(np.int32)
+        spread_words[j] = np.where(val, w | (1 << 31), w).astype(np.int64).astype(np.int32)
     t.spread = spread
+    t.gspread = gspread
     t.spread_words = spread_words
 
     # ---- slot phase ----------------------------------------------------
@@ -226,10 +303,16 @@ def build_tables(m, p, L=None, R=None):
     # u = k * S_d + s:
     #   delta_h = 2*h(s) - s  in [-2, 1]
     #   delta_t = 2*t(s) - s  in [-2, 1]
+    # A guest placement shifts every node by beta_d = base >> d inside
+    # its slot; the delta tables are UNCHANGED: the kernel's
+    # (s + delta) // 2 interleave read absorbs an odd beta_d exactly
+    # (beta_d = 2 * beta_{d+1} + eps, eps in {0, 1}, lands on
+    # beta_{d+1} + h either way).
     slot_words = np.zeros((L - NL, rows), np.int32)
     for l in range(NL + 1, L + 1):
         d = L - l
-        S_d = rows >> d               # 2**l, or 3 * 2**(l-2) (base-3)
+        S_d = rows >> d               # 2**l, or (s/8) * 2**l (odd-slot)
+        beta = base >> d
         sizes = node_sizes(m, d)
         csizes = node_sizes(m, d + 1)
         sig = np.zeros(rows, np.int64)
@@ -240,13 +323,15 @@ def build_tables(m, p, L=None, R=None):
             mn = int(sizes[k])
             if mn == 0:
                 continue
-            base = k * S_d
-            val[base : base + mn] = True
+            r0 = k * S_d + beta
+            assert beta + mn <= S_d, (m, l, k, base, rows)
+            val[r0 : r0 + mn] = True
             if mn == 1:
                 # carry: tail child holds the row (head child empty).
-                # delta_t for s=0 must read tails[k, 0]: 2*t - s = 0.
-                da[base] = 2      # delta_h = 0 -> reads empty head slot (zeros)
-                db[base] = 2      # delta_t = 0
+                # delta_t for s=0 must read tails[k, beta_{d+1}]:
+                # (beta_d + 0) // 2 = beta_{d+1} with delta 0.
+                da[r0] = 2      # delta_h = 0 -> reads empty head slot (zeros)
+                db[r0] = 2      # delta_t = 0
                 continue
             h, tt, sh = _merge_tables(mn)
             s = np.arange(mn)
@@ -254,13 +339,50 @@ def build_tables(m, p, L=None, R=None):
             dlt = 2 * tt - s
             assert (dlh >= -2).all() and (dlh <= 1).all(), (m, l, k)
             assert (dlt >= -2).all() and (dlt <= 1).all(), (m, l, k)
-            da[base : base + mn] = dlh + 2
-            db[base : base + mn] = dlt + 2
-            sig[base : base + mn] = sh
+            da[r0 : r0 + mn] = dlh + 2
+            db[r0 : r0 + mn] = dlt + 2
+            sig[r0 : r0 + mn] = sh
         sigm = sig % p
         thr = p - sigm
         slot_words[l - NL - 1] = pack_word(sigm, thr, da, db, val)
     t.slot_words = slot_words
+    return t
+
+
+def combine_tables(th, tg):
+    """Merge a base-0 host's tables with a guest's (built at a feasible
+    :func:`guest_base`) into ONE set of per-row words for the paired
+    container: each level's words select the owning trial's entry by
+    the row's structural position (guest owns in-slot offsets at or
+    above its ``base >> d`` chain). Dead rows' words are whichever
+    side's padding entry the region select lands on — their outputs
+    are invalid-masked and no live row reads them."""
+    assert th.base == 0 and tg.base > 0
+    assert (th.rows, th.L, th.NL, th.p) == (tg.rows, tg.L, tg.NL, tg.p)
+    rows, L, NL, base = th.rows, th.L, th.NL, tg.base
+    t = KernelTables()
+    t.m, t.p, t.L, t.NL, t.rows = th.m, th.p, L, NL, rows
+    t.base = 0
+    t.gm, t.gbase = tg.m, base
+    iota = np.arange(rows)
+    t.nat_words = np.where(iota[None, :] >= base, tg.nat_words,
+                           th.nat_words)
+    spread_words = np.empty_like(th.spread_words)
+    for j in range(L - NL):
+        half = rows >> (j + 1)
+        spread_words[j] = np.where((iota % half) >= (base >> (j + 1)),
+                                   tg.spread_words[j], th.spread_words[j])
+    t.spread_words = spread_words
+    slot_words = np.empty_like(th.slot_words)
+    for l in range(NL + 1, L + 1):
+        d = L - l
+        S_d = rows >> d
+        slot_words[l - NL - 1] = np.where(
+            (iota % S_d) >= (base >> d),
+            tg.slot_words[l - NL - 1], th.slot_words[l - NL - 1])
+    t.slot_words = slot_words
+    t.spread = th.spread
+    t.gspread = tg.gspread
     return t
 
 
@@ -306,13 +428,45 @@ def simulate_dense(data, L=None, P=None, R=None):
     data = np.asarray(data, dtype=np.float32)
     m, p = data.shape
     t = build_tables(m, p, L, R)
-    L, NL, rows = t.L, t.NL, t.rows
-    P = p if P is None else int(P)
+    buf = np.zeros((t.rows, p if P is None else int(P)), np.float32)
+    buf[:m, :p] = data
+    return _simulate_cascade(t, buf)[:m, :p]
+
+
+def simulate_dense_pair(data_host, data_guest, L, R, base=None, P=None):
+    """
+    The paired (row-packed) container's dense-op sequence: host trial
+    at rows [0, m_h), guest trial embedded at ``base`` (default: the
+    minimal :func:`guest_base`), SAME p. Returns (host, guest) (m, p)
+    transforms — each must equal its own ffa_transform exactly.
+    """
+    data_host = np.asarray(data_host, dtype=np.float32)
+    data_guest = np.asarray(data_guest, dtype=np.float32)
+    mh, p = data_host.shape
+    mg, pg = data_guest.shape
+    assert p == pg, "paired trials share one phase-bin count"
+    rows = int(R)
+    if base is None:
+        base = guest_base(mh, mg, L, rows)
+        assert base is not None, (mh, mg, L, rows)
+    th = build_tables(mh, p, L, rows)
+    tg = build_tables(mg, p, L, rows, base=base)
+    t = combine_tables(th, tg)
+    buf = np.zeros((rows, p if P is None else int(P)), np.float32)
+    buf[:mh, :p] = data_host
+    buf[base : base + mg, :p] = data_guest
+    out = _simulate_cascade(t, buf)
+    return out[:mh, :p], out[base : base + mg, :p]
+
+
+def _simulate_cascade(t, buf):
+    """Numpy mirror of the kernel's cascade over prebuilt (possibly
+    :func:`combine_tables`-paired) tables; `buf` is the loaded
+    (rows, P) container."""
+    L, NL, rows, p = t.L, t.NL, t.rows, t.p
+    P = buf.shape[1]
     cols = np.arange(P)
     colmask = (cols < p)[None, :]
-
-    buf = np.zeros((rows, P), np.float32)
-    buf[:m, :p] = data
 
     # natural phase
     for l in range(1, NL + 1):
@@ -340,15 +494,21 @@ def simulate_dense(data, L=None, P=None, R=None):
         out = head + np.where(lone[:, None], 0.0, tail)
         buf = np.where(valid[:, None] & colmask, out, 0.0).astype(np.float32)
 
-    # spread phase: natural depth-(L-NL) nodes -> slot-SLOT_S container,
-    # one step = select over three static whole-array row rolls.
+    # spread phase: natural depth-(L-NL) nodes -> slot container, one
+    # step = select over static whole-array row rolls (three host
+    # candidates; a paired guest adds its three at sel 3..5).
     for j, A in enumerate(t.spread):
         w = t.spread_words[j]
         half = rows >> (j + 1)
-        sel = (w >> 22) & 3
+        sel = (w >> 22) & 7
         valid = w < 0
+        offs = [(1, A - half), (2, A + 1 - half)]
+        if getattr(t, "gbase", 0):
+            Ag, aj, an = t.gspread[j]
+            offs += [(3, aj - an), (4, aj - an + Ag - half),
+                     (5, aj - an + Ag + 1 - half)]
         out = buf
-        for sv, off in ((1, A - half), (2, A + 1 - half)):
+        for sv, off in offs:
             if (sel == sv).any():
                 out = np.where((sel == sv)[:, None], _row_roll(buf, off), out)
         buf = np.where(valid[:, None], out, 0.0).astype(np.float32)
@@ -382,4 +542,4 @@ def simulate_dense(data, L=None, P=None, R=None):
         out = head + tail
         buf = np.where((w < 0)[:, None] & colmask, out, 0.0).astype(np.float32)
 
-    return buf[:m, :p]
+    return buf

@@ -571,20 +571,89 @@ def _ffa_path():
 
 def _bucket_shape(st, idx):
     """(L, NL, rows, P) of one lane bucket's kernel container, computed
-    WITHOUT building the kernel (for eligibility checks)."""
+    WITHOUT building the kernel (for eligibility checks). Container
+    height comes from the SAME flag->family mapping the kernel build
+    uses (ffa_kernel.bucket_rows), so the model cannot drift."""
+    from ..ops.ffa_kernel import bucket_rows
     from ..ops.plan import num_levels
-    from ..ops.slottables import NAT_LEVELS, container_rows
+    from ..ops.slottables import NAT_LEVELS
 
     ms = [st.ms_padded[i] for i in idx]
     ps = [st.ps_padded[i] for i in idx]
     L = max(num_levels(m) for m in ms)
     NL = min(L, NAT_LEVELS)
-    if not envflags.get("RIPTIDE_KERNEL_BASE3"):
-        rows = 1 << L
-    else:
-        rows = container_rows(max(ms), L)
+    rows = bucket_rows(ms, L)
     P = -(-max(ps) // 128) * 128
     return L, NL, rows, P
+
+
+def _row_pack_map(plan, mode):
+    """Row-pack pairing decisions of the fused kernel path: which
+    guest buckets co-habit which host buckets' dead container rows.
+
+    Greedy earliest-guest-first over (stage, lane bucket) pairs at the
+    SAME bucket position (identical p list — the paired kernel shares
+    every per-program scalar between the two trials): a later stage's
+    bucket is absorbed when every trial it needs read back has a
+    feasible guest base in its same-position host container
+    (ops.plan.pair_bucket_bases) and the paired program's decode
+    scratch fits the VMEM model. Returns {} when
+    RIPTIDE_KERNEL_ROW_PACK is off or the wire is not quantised;
+    otherwise {(stage, bucket): ("host", guest_stage, bases) |
+    ("guest", host_stage)}. Cached on the plan per flag state — queue,
+    warmup, the lowering hooks and the occupancy accounting all
+    consult the SAME map."""
+    from ..ops.ffa_kernel import (VMEM_LIMIT, WIRE_MODES,
+                                  kernel_vmem_bytes)
+    from ..ops.plan import pair_bucket_bases
+
+    if not envflags.get("RIPTIDE_KERNEL_ROW_PACK") or mode not in WIRE_MODES:
+        return {}
+    fp = (mode, bool(envflags.get("RIPTIDE_KERNEL_BASE3")),
+          bool(envflags.get("RIPTIDE_KERNEL_LANE_SPLIT")),
+          bool(envflags.get("RIPTIDE_KERNEL_RESIDENT")))
+    cache = getattr(plan, "_row_pack_maps", None)
+    if cache is None:
+        cache = plan._row_pack_maps = {}
+    rpm = cache.get(fp)
+    if rpm is not None:
+        return rpm
+    PW = _view_width(plan)
+    stages = plan.stages
+    elig = [_fused_eligible(st, plan, mode) for st in stages]
+    entries = {}
+    for s, st in enumerate(stages):
+        if not elig[s]:
+            continue
+        for k, idx in enumerate(st.lane_buckets):
+            if (s, k) in entries:
+                continue
+            L, NL, rows, P = _bucket_shape(st, idx)
+            ms = [st.ms_padded[i] for i in idx]
+            ps = [st.ps_padded[i] for i in idx]
+            for s2 in range(s + 1, len(stages)):
+                st2 = stages[s2]
+                if ((s2, k) in entries or not elig[s2]
+                        or st2.lane_buckets != st.lane_buckets
+                        or [st2.ps_padded[i] for i in idx] != ps):
+                    continue
+                nb2 = len(st2.bins)
+                skip = tuple(j for j, g in enumerate(idx)
+                             if g >= nb2 or st2.rows_eval[g] == 0)
+                bases = pair_bucket_bases(
+                    ms, [st2.ms_padded[i] for i in idx], L, rows, skip)
+                if bases is None:
+                    continue
+                gext = max(rows - b for b in bases if b is not None)
+                if kernel_vmem_bytes(L, NL, rows, P, False,
+                                     fused_mode=mode, PW=PW,
+                                     gext=gext) >= VMEM_LIMIT:
+                    continue
+                entries[(s, k)] = ("host", s2, bases)
+                entries[(s2, k)] = ("guest", s)
+                break
+    cache[fp] = entries
+    return entries
 
 
 def _kernel_eligible(st, plan):
@@ -639,48 +708,110 @@ def _count_dispatch(kind, n=1):
     get_metrics().add(f"dispatch_{kind}", n)
 
 
-def _stagevec(st, vl, i, roff, mode):
+def _stagevec(st, vl, i, roff, mode, guest=None):
     """(1, 8) int32 device stage vector of the fused call: [wire row
     offset (part-relative), plane rows, scale row offset, view rows,
-    0...]; cached on the stage per (mode, part offset)."""
+    then the row-packed guest stage's same four (or zeros)]; cached on
+    the stage per (mode, part offset, guest)."""
     cache = getattr(st, "_stagevecs", None)
     if cache is None:
         cache = st._stagevecs = {}
-    key = (mode, i, roff)
+    key = (mode, i, roff, guest)
     sv = cache.get(key)
     if sv is None:
+        gvals = [0, 0, 0, 0]
+        if guest is not None:
+            gi, groff = guest
+            gvals = [groff, vl["prs"][gi], vl["soffs"][gi],
+                     vl["r0s"][gi]]
         sv = cache[key] = jnp.asarray(np.asarray(
-            [[roff, vl["prs"][i], vl["soffs"][i], vl["r0s"][i],
-              0, 0, 0, 0]], np.int32))
+            [[roff, vl["prs"][i], vl["soffs"][i], vl["r0s"][i]]
+             + gvals], np.int32))
     return sv
 
 
-def _run_stage_fused(st, wire_part, roff, plan, meta, i):
+def _stage_pairing(plan, rpm, i, st, parts, part_of):
+    """The row-pack pairing input of :func:`_run_stage_fused` for stage
+    ``i``: which lane buckets are absorbed elsewhere, and per hosting
+    bucket the guest stage + bases + the guest's wire part. Shared by
+    the live queue and the lowering hooks so the traced programs are
+    exactly the queued ones. None when the stage is untouched."""
+    absorbed = set()
+    hosted = {}
+    for k in range(len(st.lane_buckets)):
+        e = rpm.get((i, k))
+        if e is None:
+            continue
+        if e[0] == "guest":
+            absorbed.add(k)
+        else:
+            s2, bases = e[1], e[2]
+            c2, off2 = part_of[s2]
+            hosted[k] = (plan.stages[s2], bases, parts[c2], off2, s2)
+    if absorbed or hosted:
+        return {"absorbed": absorbed, "hosted": hosted}
+    return None
+
+
+def _run_stage_fused(st, wire_part, roff, plan, meta, i, pairing=None):
     """Queue one FUSED cascade stage: one Pallas program per lane
     bucket doing wire decode + dequant + (m, p) pack + FFA + S/N — the
     former per-stage XLA pack program (and its (D, B, rows, P) f32
-    container round-trip through HBM) is gone. Returns a tuple of
-    per-bucket (..., B_k, rows_eval_max_k, NW) containers unsynced,
-    each sliced immediately so the raw (B_k, RS, 128) output can be
-    freed before assembly."""
+    container round-trip through HBM) is gone.
+
+    ``pairing`` (from the row-pack map) names this stage's absorbed
+    buckets (queue NOTHING — their trials ride an earlier host) and
+    hosting buckets (run the PAIRED kernel against the guest stage's
+    wire part). Returns (outs, kept): per queued bucket the
+    (..., B_k, rows_eval_max_k, NW) container unsynced — sliced
+    immediately so the raw (B_k, RS, 128) output can be freed before
+    assembly, with the slice covering any guest rows — plus the queued
+    bucket positions for the assembly layout."""
     interpret = jax.default_backend() == "cpu"
     vl = meta["view"]
     nw = len(plan.widths)
     nre = len(st.rows_eval)
-    sv = _stagevec(st, vl, i, roff, meta["mode"])
+    if pairing is not None and len(pairing["absorbed"]) == len(
+            st.lane_buckets):
+        return (), ()  # fully absorbed: every trial rides a host stage
     outs = []
+    kept = []
     for k, (idx, kern) in enumerate(st.cycle_kernels(interpret=interpret)):
+        host = None
+        if pairing is not None:
+            if k in pairing["absorbed"]:
+                continue
+            host = pairing["hosted"].get(k)
+        if host is not None:
+            st2, bases, gpart, groff, gi = host
+            kern = st.paired_cycle_kernel(k, st2, bases,
+                                          interpret=interpret)
+            sv = _stagevec(st, vl, i, roff, meta["mode"],
+                           guest=(gi, groff))
+        else:
+            sv = _stagevec(st, vl, i, roff, meta["mode"])
         # Enqueue-side span: times the (async) dispatch call itself,
         # tagged with the dispatch kind + lane bucket so a trace shows
         # which buckets dominate queueing cost. Never a sync point.
         with span("dispatch", kind="fused", stage=i, bucket=k):
-            out = kern.run_fused(sv, wire_part, meta["scales_dev"],
-                                 meta["mode"])
+            if host is not None:
+                out = kern.run_fused(sv, wire_part, meta["scales_dev"],
+                                     meta["mode"], gwire_dev=gpart)
+            else:
+                out = kern.run_fused(sv, wire_part, meta["scales_dev"],
+                                     meta["mode"])
         _count_dispatch("fused")
         remax = max([st.rows_eval[g] for g in idx if g < nre] or [0])
+        if host is not None:
+            n2 = len(st2.rows_eval)
+            remax = max([remax] + [
+                bases[j] + st2.rows_eval[g]
+                for j, g in enumerate(idx)
+                if bases[j] is not None and g < n2])
         outs.append(out[..., : max(remax, 1), :nw])
         _count_dispatch("slice")
-    return tuple(outs)
+        kept.append(k)
+    return tuple(outs), tuple(kept)
 
 
 def _run_stage_kernel(st, flat_dev, off, plan, meta, i):
@@ -801,24 +932,36 @@ def _assemble_device(plan, layout, *outs):
     evaluated rows and concatenate in plan trial order, keeping the
     (D, n_trials, NW) S/N cube on the device (for on-device peak
     detection — only KB-sized peak summaries then cross to the host).
-    ``outs[s]`` is a tuple of that stage's per-lane-bucket containers
-    (a 1-tuple on the unsplit paths); ``layout[s]`` names each bucket's
-    original problem indices (None for a single full-batch bucket) so
-    the concatenation preserves the reference's (cycle, bins, shift)
-    trial order."""
+    ``outs[s]`` is a tuple of that stage's QUEUED per-lane-bucket
+    containers (a 1-tuple on the unsplit paths); ``layout[s]`` is None
+    for a single full-batch bucket, else one entry per lane bucket:
+    ``("own", pos, idx)`` reads ``outs[s][pos]``, and a row-packed
+    ``("guest", host_s, host_pos, idx, bases)`` de-interleaves this
+    bucket's trials from the HOST stage's container at each trial's
+    guest base row — preserving the reference's (cycle, bins, shift)
+    trial order either way."""
     nw = len(plan.widths)
     chunks = []
-    for st, raws, buckets in zip(plan.stages, outs, layout):
-        if buckets is None:
-            pos = {i: (0, i) for i in range(len(st.rows_eval))}
+    for s, (st, raws, lay) in enumerate(zip(plan.stages, outs, layout)):
+        if lay is None:
+            pos = {i: (raws[0], i, 0) for i in range(len(st.rows_eval))}
         else:
-            pos = {g: (k, j) for k, idx in enumerate(buckets)
-                   for j, g in enumerate(idx)}
+            pos = {}
+            for e in lay:
+                if e[0] == "own":
+                    _, p_, idx = e
+                    for j, g in enumerate(idx):
+                        pos[g] = (raws[p_], j, 0)
+                else:
+                    _, hs, hp, idx, bases = e
+                    for j, g in enumerate(idx):
+                        pos[g] = (None if bases[j] is None
+                                  else (outs[hs][hp], j, bases[j]))
         for i, re in enumerate(st.rows_eval):
             if re:
-                k, j = pos[i]
-                # raws[k]: kernel (D, Bk, RS, 128) or gather (D, B, R, NW)
-                chunks.append(raws[k][:, j, :re, :nw])
+                raw, j, off = pos[i]
+                # raw: kernel (D, Bk, RS, 128) or gather (D, B, R, NW)
+                chunks.append(raw[:, j, off : off + re, :nw])
     return jnp.concatenate(chunks, axis=1)
 
 
@@ -944,15 +1087,36 @@ def _queue_stages(plan, batch, prepared=None, shipped=None):
         shipped = ship_stage_data(plan, prepared)
     parts, part_of, meta = shipped
     path, mode = meta["path"], meta["mode"]
+    rpm = _row_pack_map(plan, mode) if path == "kernel" else {}
 
     outs = []
     layout = []
+    bucketpos = {}  # (stage, bucket) -> position in that stage's outs
     for i, st in enumerate(plan.stages):
         c, off = part_of[i]
         if path == "kernel" and _fused_eligible(st, plan, mode):
             buckets = st.lane_buckets
-            outs.append(_run_stage_fused(st, parts[c], off, plan, meta, i))
-            layout.append(buckets if len(buckets) > 1 else None)
+            pairing = _stage_pairing(plan, rpm, i, st, parts, part_of)
+            absorbed = pairing["absorbed"] if pairing else set()
+            souts, kept = _run_stage_fused(st, parts[c], off, plan,
+                                           meta, i, pairing=pairing)
+            outs.append(souts)
+            for pos, k in enumerate(kept):
+                bucketpos[(i, k)] = pos
+            if len(buckets) == 1 and not absorbed:
+                layout.append(None)
+                continue
+            entries = []
+            for k in range(len(buckets)):
+                if k in absorbed:
+                    hs = rpm[(i, k)][1]
+                    bases = rpm[(hs, k)][2]
+                    entries.append(("guest", hs, bucketpos[(hs, k)],
+                                    buckets[k], bases))
+                else:
+                    entries.append(("own", bucketpos[(i, k)],
+                                    buckets[k]))
+            layout.append(tuple(entries))
             continue
         layout.append(None)
         if path == "kernel" and _kernel_eligible(st, plan):
@@ -1072,10 +1236,23 @@ def warm_stage_kernels(plan, D, parallel=True):
         vl = _view_layout(plan, mode)
         prows = _part_rows(plan, mode)
         srows = vl["stot"]
+        rpm = _row_pack_map(plan, mode)
     for i, st in enumerate(plan.stages):
         if mode in _WIRE_Q and _fused_eligible(st, plan, mode):
-            for _, kern in st.cycle_kernels(interpret=interpret):
-                c = kern.build_fused(D, mode, vl["PW"], prows[i], srows)
+            for k, (idx, kern) in enumerate(
+                    st.cycle_kernels(interpret=interpret)):
+                e = rpm.get((i, k))
+                if e is not None and e[0] == "guest":
+                    continue  # absorbed: rides its host stage's build
+                if e is not None and e[0] == "host":
+                    s2, bases = e[1], e[2]
+                    kern = st.paired_cycle_kernel(
+                        k, plan.stages[s2], bases, interpret=interpret)
+                    c = kern.build_fused(D, mode, vl["PW"], prows[i],
+                                         srows, gwrows=prows[s2])
+                else:
+                    c = kern.build_fused(D, mode, vl["PW"], prows[i],
+                                         srows)
                 if hasattr(c, "warm"):
                     calls.setdefault(id(c), c)
         elif _kernel_eligible(st, plan):
@@ -1172,11 +1349,35 @@ def staged_stage_programs(plan, D, path=None, mode=None):
     mode = mode or _wire_mode(path)
     parts, part_of, scales = staged_wire_operands(plan, D, mode)
     meta = _staged_meta(plan, path, mode)
+    rpm = _row_pack_map(plan, mode) if path == "kernel" else {}
     records = []
     for i, st in enumerate(plan.stages):
         c, off = part_of[i]
         part = parts[c]
         if path == "kernel" and _fused_eligible(st, plan, mode):
+            nk = len(st.lane_buckets)
+            if all(rpm.get((i, k), ("",))[0] == "guest"
+                   for k in range(nk)):
+                # Row-packed and fully absorbed: the stage queues NO
+                # program of its own (its trials ride earlier hosts).
+                records.append({"stage": i, "kind": "absorbed",
+                                "fn": lambda: (), "args": (),
+                                "donate": ()})
+                continue
+            if any((i, k) in rpm for k in range(nk)):
+                # Hosting (or partially absorbed): the queued programs
+                # read every shipped part (the guest stage's lives in
+                # another), exactly as _queue_stages wires them.
+                def fn(*ops, st=st, off=off, i=i):
+                    m = dict(meta, scales_dev=ops[-1])
+                    pr = _stage_pairing(plan, rpm, i, st,
+                                        list(ops[:-1]), part_of)
+                    return _run_stage_fused(st, ops[part_of[i][0]], off,
+                                            plan, m, i, pairing=pr)
+                records.append({"stage": i, "kind": "fused", "fn": fn,
+                                "args": tuple(parts) + (scales,),
+                                "donate": ()})
+                continue
             kind, runner = "fused", _run_stage_fused
         elif path == "kernel" and _kernel_eligible(st, plan):
             kind, runner = "kernel", _run_stage_kernel

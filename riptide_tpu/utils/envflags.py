@@ -73,6 +73,17 @@ _ALL = [
         since="PR 0 (0.3.0)",
     ),
     EnvFlag(
+        "RIPTIDE_KERNEL_ROW_PACK", "bool", True,
+        "Row-packed kernel containers: the odd-slot container forms "
+        "(5/7 * 2^(L-3)) join the bucket family, and a second same-p "
+        "bins-trial is packed into a container's dead rows via per-row "
+        "table indirection where the plan's cross-stage pairing finds "
+        "a fit (results stay bit-identical per trial; buckets with no "
+        "reclaim or over the VMEM model fall back automatically). `0` "
+        "reverts to the pre-row-pack layout.",
+        since="PR 15 (0.14.0)",
+    ),
+    EnvFlag(
         "RIPTIDE_KERNEL_LANE_SPLIT", "bool", True,
         "Split each stage's bins trials into lane-occupancy buckets "
         "(grouped by ceil(p / 128) tiles) so most trials run in a "

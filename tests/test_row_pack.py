@@ -1,0 +1,421 @@
+"""
+Row-packed kernel containers (RIPTIDE_KERNEL_ROW_PACK): the odd-slot
+container forms 5/7 * 2^(L-3) and the embedding of a SECOND same-p
+bins-trial in a container's dead rows via per-row table indirection
+(slottables.build_tables(base=...) / combine_tables, the paired
+CycleKernel, engine._row_pack_map and the guest de-interleave in
+_assemble_device).
+
+Correctness chain:
+
+* table level — simulate_dense on odd-slot containers and
+  simulate_dense_pair on embedded pairs equal the reference oracle
+  EXACTLY, per trial, across edge geometries (m near rows, non-minimal
+  guest bases, m = 1 guests, every container form);
+* kernel level — the paired interpret-mode CycleKernel matches the
+  oracle for both trials AND its host rows are BITWISE identical to
+  the unpaired kernel's (the guest rides only in dead rows);
+* engine level — a DM-batched CPU survey e2e produces byte-identical
+  peaks.csv with the flag on vs off, row-packed stages queue ONE fused
+  program per non-absorbed lane bucket and ZERO pack programs, the
+  fused and two-dispatch forms stay bitwise interchangeable, and the
+  flag-off escape hatch restores the legacy container family exactly.
+
+The e2e plans force RIPTIDE_KERNEL_BASE3=0 (pure 2^L buckets): a
+MINIMAL container's largest trial fills every slot, so cross-stage
+pairing engages where the family is coarse — which the pure-2^L family
+is at these tiny depths (see docs/perf_notes.md round 7).
+"""
+import io
+
+import numpy as np
+import pytest
+
+import riptide_tpu.search.engine as eng
+from riptide_tpu.ops.ffa_kernel import CycleKernel, bucket_rows
+from riptide_tpu.ops.plan import num_levels, pair_bucket_bases
+from riptide_tpu.ops.reference import boxcar_snr_2d, ffa_transform
+from riptide_tpu.ops.slottables import (container_forms, container_rows,
+                                        guest_base, simulate_dense,
+                                        simulate_dense_pair)
+from riptide_tpu.ops.snr import boxcar_coeffs
+from riptide_tpu.search.plan import periodogram_plan, plan_occupancy
+from riptide_tpu.survey.metrics import MetricsRegistry, set_metrics
+
+# E2E config: tiny series, 5 cascade stages, one cross-stage pair
+# (stage 0 hosts stage 2) under pure-2^L buckets — probed so the
+# interpret-mode cost stays tens of seconds.
+SIZE, TSAMP, WIDTHS = 1200, 1e-3, (1, 2, 3)
+PMIN, PMAX, BMIN, BMAX = 32e-3, 0.11, 32, 40
+PKW = dict(smin=6.0, segwidth=0.2, nstd=6.0, minseg=10, polydeg=2,
+           clrad=0.1)
+
+
+# --------------------------------------------------------- table level
+
+def test_container_forms_extended():
+    assert container_forms(10) == [768, 1024]
+    assert container_forms(10, extended=True) == [640, 768, 896, 1024]
+    # odd-slot forms need L >= 6 for the 8-row sublane tile
+    assert container_forms(5, extended=True) == [24, 32]
+    assert container_rows(600, 10, extended=True) == 640
+    assert container_rows(641, 10, extended=True) == 768
+    assert container_rows(600, 10) == 768
+
+
+@pytest.mark.parametrize("m,p", [(100, 130), (300, 37), (71, 64),
+                                 (623, 17), (160, 9)])
+def test_simulate_dense_odd_slot_containers(m, p):
+    """5/7 * 2^(L-3) containers stay oracle-exact: the spread halves
+    group sizes only ABOVE the final slot, so an odd slot is legal."""
+    rng = np.random.default_rng(m)
+    data = rng.standard_normal((m, p)).astype(np.float32)
+    L = num_levels(m)
+    for R in container_forms(L, extended=True):
+        if R >= m:
+            np.testing.assert_array_equal(simulate_dense(data, L=L, R=R),
+                                          ffa_transform(data))
+
+
+PAIR_GEOMS = [
+    # (m_host, m_guest, p): m near rows, tiny guests, lone-row guests
+    (700, 200, 130), (1006, 237, 241), (555, 100, 37), (120, 30, 17),
+    (96, 30, 16), (250, 60, 251), (1000, 9, 33), (60, 3, 7),
+    (33, 1, 5), (700, 1, 130),
+]
+
+
+@pytest.mark.parametrize("mh,mg,p", PAIR_GEOMS)
+def test_simulate_dense_pair_matches_oracle(mh, mg, p):
+    """Both trials of an embedded pair equal their own reference
+    transforms EXACTLY, on every feasible container form, at the
+    minimal guest base and at a feasible non-minimal one."""
+    from riptide_tpu.ops.slotffa import node_sizes
+
+    rng = np.random.default_rng(mh * 7 + mg)
+    checked = 0
+    # L and L+1: a bucket's depth comes from its LARGEST trial, so a
+    # host often sits one level deeper than its own minimum.
+    for L in (num_levels(mh), num_levels(mh) + 1):
+        NL = min(L, 3)
+        for R in container_forms(L, extended=True):
+            if R < mh:
+                continue
+            gb = guest_base(mh, mg, L, R)
+            if gb is None:
+                continue
+            bases = [gb]
+            for extra in (1, 5):  # a non-minimal (odd-offset) base
+                b2 = gb + extra
+                if b2 + mg <= R and all(
+                        (b2 >> d) + int(node_sizes(mg, d).max())
+                        <= (R >> d)
+                        for d in range(L - NL + 1)):
+                    bases.append(b2)
+                    break
+            for base in bases:
+                dh = rng.standard_normal((mh, p)).astype(np.float32)
+                dg = rng.standard_normal((mg, p)).astype(np.float32)
+                oh, og = simulate_dense_pair(dh, dg, L, R, base=base)
+                np.testing.assert_array_equal(oh, ffa_transform(dh))
+                np.testing.assert_array_equal(og, ffa_transform(dg))
+                checked += 1
+    assert checked, f"no feasible embedding for ({mh}, {mg})"
+
+
+def test_guest_base_feasibility():
+    # a full container has no dead rows to lend
+    assert guest_base(1024, 10, 10, 1024) is None
+    # guest bigger than the slack
+    assert guest_base(800, 400, 10, 1024) is None
+    # the known-good case: base at the host's slot ceiling
+    assert guest_base(800, 100, 10, 1024) == 896
+    # pair_bucket_bases: skip positions need no feasibility
+    assert pair_bucket_bases([1024, 800], [5, 100], 10, 1024,
+                             skip=(0,)) == (None, 896)
+    assert pair_bucket_bases([1024, 800], [5, 100], 10, 1024) is None
+
+
+# -------------------------------------------------------- kernel level
+
+def _paired_case(ms, ps, gms, bases, widths=(1, 2, 3), seed=3):
+    B = len(ms)
+    h = np.zeros((B, len(widths)), np.float32)
+    b = np.zeros_like(h)
+    for i, p in enumerate(ps):
+        h[i], b[i] = boxcar_coeffs(p, widths)
+    std = np.linspace(1.0, 2.0, B).astype(np.float32)
+    gstd = np.linspace(1.5, 2.5, B).astype(np.float32)
+    k = CycleKernel(ms, ps, widths, h, b, std, interpret=True,
+                    guests=dict(ms=gms, bases=bases, hcoef=h, bcoef=b,
+                                stdnoise=gstd))
+    k0 = CycleKernel(ms, ps, widths, h, b, std, interpret=True)
+    rng = np.random.default_rng(seed)
+    x = np.zeros((B, k.rows, k.P), np.float32)
+    x0 = np.zeros((B, k0.rows, k0.P), np.float32)
+    dh, dg = [], []
+    for i, (m, p, gm, bb) in enumerate(zip(ms, ps, gms, bases)):
+        d1 = rng.standard_normal((m, p)).astype(np.float32)
+        d2 = rng.standard_normal((gm, p)).astype(np.float32)
+        dh.append(d1)
+        dg.append(d2)
+        x[i, :m, :p] = d1
+        x0[i, :m, :p] = d1
+        if bb is not None:
+            x[i, bb : bb + gm, :p] = d2
+    return k, k0, x, x0, dh, dg, std, gstd, widths
+
+
+def test_paired_cycle_kernel_oracle_and_host_bitwise(monkeypatch):
+    """Interpret-mode paired kernel: both trials match the reference
+    S/N, and the host trial's rows are BITWISE what the unpaired
+    kernel computes (the guest rides only in dead rows). Includes a
+    lone unpaired trial (base None) and an m=1 padding host."""
+    monkeypatch.setenv("RIPTIDE_KERNEL_BASE3", "0")
+    ms, ps, gms = [200, 190, 1], [33, 40, 33], [24, 30, 1]
+    L = max(num_levels(m) for m in ms)
+    rows = 1 << L
+    bases = [guest_base(m, gm, L, rows) for m, gm in zip(ms, gms)]
+    bases[1] = None  # lone trial in a paired bucket
+    k, k0, x, x0, dh, dg, std, gstd, widths = _paired_case(
+        ms, ps, gms, bases)
+    assert k.rows == rows
+    out = np.asarray(k(x))
+    out0 = np.asarray(k0(x0))
+    nw = len(widths)
+    for i, (m, p, gm, bb) in enumerate(zip(ms, ps, gms, bases)):
+        if m > 1:
+            want = boxcar_snr_2d(ffa_transform(dh[i]), np.asarray(widths),
+                                 stdnoise=float(std[i]))
+            np.testing.assert_allclose(out[i, :m, :nw], want,
+                                       rtol=2e-4, atol=2e-4)
+        if bb is not None and gm > 1:
+            wantg = boxcar_snr_2d(ffa_transform(dg[i]),
+                                  np.asarray(widths),
+                                  stdnoise=float(gstd[i]))
+            np.testing.assert_allclose(out[i, bb : bb + gm, :nw], wantg,
+                                       rtol=2e-4, atol=2e-4)
+        np.testing.assert_array_equal(out[i, :m, :nw], out0[i, :m, :nw])
+
+
+def test_cycle_kernel_odd_slot_container():
+    """Interpret-mode kernel on a 5-row-slot (5 * 2^(L-3)) bucket."""
+    ms, ps = [75, 78], [33, 40]
+    widths = (1, 2, 3)
+    B = len(ms)
+    h = np.zeros((B, len(widths)), np.float32)
+    b = np.zeros_like(h)
+    for i, p in enumerate(ps):
+        h[i], b[i] = boxcar_coeffs(p, widths)
+    std = np.linspace(1.0, 2.0, B).astype(np.float32)
+    k = CycleKernel(ms, ps, widths, h, b, std, interpret=True)
+    assert k.rows == 5 << (k.L - 3), (k.rows, k.L)
+    rng = np.random.default_rng(5)
+    x = np.zeros((B, k.rows, k.P), np.float32)
+    datas = []
+    for i, (m, p) in enumerate(zip(ms, ps)):
+        d = rng.standard_normal((m, p)).astype(np.float32)
+        datas.append(d)
+        x[i, :m, :p] = d
+    out = np.asarray(k(x))
+    for i, (m, p) in enumerate(zip(ms, ps)):
+        want = boxcar_snr_2d(ffa_transform(datas[i]), np.asarray(widths),
+                             stdnoise=float(std[i]))
+        np.testing.assert_allclose(out[i, :m, :len(widths)], want,
+                                   rtol=2e-4, atol=2e-4)
+
+
+# -------------------------------------------------------- engine level
+
+@pytest.fixture()
+def kernel_env(monkeypatch):
+    monkeypatch.setenv("RIPTIDE_FFA_PATH", "kernel")
+    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", "uint6")
+    monkeypatch.setenv("RIPTIDE_KERNEL_BASE3", "0")
+    return monkeypatch
+
+
+@pytest.fixture(scope="module")
+def plan():
+    return periodogram_plan(SIZE, TSAMP, WIDTHS, PMIN, PMAX, BMIN, BMAX)
+
+
+@pytest.fixture(scope="module")
+def batch():
+    from riptide_tpu.libffa import generate_signal
+
+    rng = np.random.default_rng(21)
+    b = rng.standard_normal((2, SIZE)).astype(np.float32)
+    np.random.seed(9)
+    b[0] = generate_signal(SIZE, 0.05 / TSAMP, amplitude=14.0, ducy=0.08)
+    b -= b.mean(axis=1, keepdims=True)
+    b /= b.std(axis=1, keepdims=True)
+    return b
+
+
+def test_row_pack_map_pairs(plan, kernel_env):
+    rpm = eng._row_pack_map(plan, "uint6")
+    hosts = {k: v for k, v in rpm.items() if v[0] == "host"}
+    guests = {k: v for k, v in rpm.items() if v[0] == "guest"}
+    assert hosts and len(hosts) == len(guests)
+    for (s, k), (_, s2, bases) in hosts.items():
+        assert rpm[(s2, k)] == ("guest", s)
+        st, st2 = plan.stages[s], plan.stages[s2]
+        idx = st.lane_buckets[k]
+        L, NL, rows, P = eng._bucket_shape(st, idx)
+        for j, g in enumerate(idx):
+            if bases[j] is None:
+                continue
+            assert bases[j] + st2.ms_padded[g] <= rows
+            assert bases[j] >= st.ms_padded[g]
+    # the map is a device-layout property: flag off empties it
+    kernel_env.setenv("RIPTIDE_KERNEL_ROW_PACK", "0")
+    assert eng._row_pack_map(plan, "uint6") == {}
+
+
+def test_dm_batched_peaks_byte_identical_flag_on_off(plan, batch,
+                                                     kernel_env):
+    """THE acceptance e2e: a DM-batched CPU survey through the fused
+    path with on-device peaks — S/N cube and peaks.csv bytes identical
+    with RIPTIDE_KERNEL_ROW_PACK=1 vs 0, while the flag-on run queues
+    FEWER fused programs (the absorbed bucket) and zero pack
+    programs."""
+    import pandas
+
+    from riptide_tpu.search.engine import (
+        collect_search_batch, queue_search_batch, search_snr_dev,
+    )
+
+    tobs = SIZE * TSAMP
+
+    def run():
+        reg = MetricsRegistry()
+        prev = set_metrics(reg)
+        try:
+            handle = queue_search_batch(plan, batch, tobs=tobs, **PKW)
+            snr = np.asarray(search_snr_dev(handle))
+            peaks, _ = collect_search_batch(handle, np.zeros(2))
+        finally:
+            set_metrics(prev)
+        return snr, peaks, reg.summary()
+
+    def csv_bytes(peaks):
+        buf = io.StringIO()
+        pandas.DataFrame(peaks).to_csv(buf, index=False)
+        return buf.getvalue().encode()
+
+    snr_on, peaks_on, m_on = run()
+    kernel_env.setenv("RIPTIDE_KERNEL_ROW_PACK", "0")
+    snr_off, peaks_off, m_off = run()
+
+    np.testing.assert_array_equal(snr_on, snr_off)
+    assert any(peaks_on[0]), "expected the injected pulsar detected"
+    for d in range(2):
+        assert csv_bytes(peaks_on[d]) == csv_bytes(peaks_off[d])
+
+    n_absorbed = sum(1 for v in eng._row_pack_map(plan, "uint6").values()
+                     if v[0] == "guest")
+    kernel_env.setenv("RIPTIDE_KERNEL_ROW_PACK", "1")
+    rpm = eng._row_pack_map(plan, "uint6")
+    n_absorbed = sum(1 for v in rpm.values() if v[0] == "guest")
+    assert n_absorbed >= 1
+    assert m_on.get("dispatch_fused") == \
+        m_off.get("dispatch_fused") - n_absorbed
+    assert m_on.get("dispatch_pack", 0) == 0
+    assert m_off.get("dispatch_pack", 0) == 0
+
+
+def test_row_packed_stage_queues_one_fused_no_pack(plan, batch,
+                                                   kernel_env):
+    """Dispatch-count regression with tripwired pack entry points: a
+    row-packed run still queues exactly one fused program per
+    NON-absorbed stage lane bucket and never a separate pack
+    program."""
+
+    def _no_pack(*a, **k):
+        raise AssertionError("separate pack program dispatched on the "
+                             "row-packed fused path")
+
+    kernel_env.setattr(eng, "_pack_static_view", _no_pack)
+    kernel_env.setattr(eng, "_pack_static", _no_pack)
+    rpm = eng._row_pack_map(plan, "uint6")
+    want = sum(
+        1
+        for i, st in enumerate(plan.stages)
+        for k in range(len(st.lane_buckets))
+        if rpm.get((i, k), ("",))[0] != "guest"
+    )
+    reg = MetricsRegistry()
+    prev = set_metrics(reg)
+    try:
+        eng.run_periodogram(plan, batch[0])
+    finally:
+        set_metrics(prev)
+    s = reg.summary()
+    assert s.get("dispatch_fused") == want
+    assert s.get("dispatch_pack", 0) == 0
+    assert s.get("dispatch_kernel", 0) == 0
+
+
+def test_fused_equals_two_dispatch_with_flag_on(plan, batch, kernel_env):
+    """With the flag ON, forcing the two-dispatch form (which never
+    row-packs — pairing is a fused-path layout) must still give the
+    BITWISE same S/N: per-trial results are layout-independent."""
+    _, _, s_fused = eng.run_periodogram(plan, batch[1])
+    kernel_env.setattr(eng, "_fused_eligible", lambda *a: False)
+    _, _, s_two = eng.run_periodogram(plan, batch[1])
+    np.testing.assert_array_equal(s_fused, s_two)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["uint8", "uint12"])
+def test_row_pack_parity_other_wire_modes(plan, batch, kernel_env, mode):
+    """Flag on/off bitwise parity holds for every quantised wire mode
+    (odd stage tails included — SIZE is not a multiple of PW)."""
+    kernel_env.setenv("RIPTIDE_WIRE_DTYPE", mode)
+    _, _, s_on = eng.run_periodogram(plan, batch[0])
+    kernel_env.setenv("RIPTIDE_KERNEL_ROW_PACK", "0")
+    _, _, s_off = eng.run_periodogram(plan, batch[0])
+    np.testing.assert_array_equal(s_on, s_off)
+
+
+def test_flag_off_reverts_containers(plan, kernel_env):
+    """The escape hatch: RIPTIDE_KERNEL_ROW_PACK=0 restores the legacy
+    container family exactly (and the single-trial plans keep working:
+    a one-stage plan has no pairing candidates at all)."""
+    kernel_env.setenv("RIPTIDE_KERNEL_BASE3", "1")
+    assert bucket_rows([600], 10) == 640
+    kernel_env.setenv("RIPTIDE_KERNEL_ROW_PACK", "0")
+    assert bucket_rows([600], 10) == 768
+    kernel_env.setenv("RIPTIDE_KERNEL_BASE3", "0")
+    assert bucket_rows([600], 10) == 1024
+    single = periodogram_plan(1200, 1e-3, (1, 2), 34e-3, 0.036, 32, 40)
+    assert len(single.stages) == 1
+    assert eng._row_pack_map(single, "uint6") == {}
+
+
+def test_plan_occupancy_accounting(plan, kernel_env):
+    occ = plan_occupancy(plan)
+    t = occ["totals"]
+    assert t["computed_rowlane"] - t["live_rowlane"] == \
+        t["padded_rowlane"] >= 0
+    assert t["legacy_padded_rowlane"] >= t["padded_rowlane"]
+    assert occ["pairs"] >= 1
+    assert t["padded_reduction_vs_legacy"] > 0
+    assert len(occ["buckets"]) == sum(len(st.lane_buckets)
+                                      for st in plan.stages)
+    roles = {b["role"] for b in occ["buckets"]}
+    assert "host" in roles and "guest" in roles
+    # per-bucket identities
+    for b in occ["buckets"]:
+        if b["role"] == "guest":
+            assert b["computed_rowlane"] == 0
+        else:
+            assert b["computed_rowlane"] == b["B"] * b["rows"] * b["P"]
+    # flag off: no pairs, zero reduction vs itself
+    kernel_env.setenv("RIPTIDE_KERNEL_ROW_PACK", "0")
+    occ0 = plan_occupancy(plan)
+    assert occ0["pairs"] == 0
+    assert occ0["totals"]["padded_reduction_vs_legacy"] == 0.0
+    assert occ0["totals"]["computed_rowlane"] == \
+        occ0["totals"]["legacy_computed_rowlane"]

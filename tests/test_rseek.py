@@ -97,6 +97,24 @@ def test_rseek_pure_noise_returns_none(tmp_path, capsys):
     assert "No peaks found" in capsys.readouterr().out
 
 
+def test_rseek_plan_stats(tmp_path, capsys):
+    """--plan-stats prints the container-occupancy accounting as JSON
+    and exits without searching."""
+    import json
+
+    inf = generate_data_presto(
+        tmp_path, "plan_stats", tobs=TOBS, tsamp=TSAMP, period=PERIOD,
+        dm=0.0, amplitude=20.0, ducy=0.02,
+    )
+    assert _run(inf, "presto", extra=("--plan-stats",)) is None
+    out = capsys.readouterr().out
+    occ = json.loads(out[out.index("{"):])
+    t = occ["totals"]
+    assert t["computed_rowlane"] - t["live_rowlane"] == \
+        t["padded_rowlane"] >= 0
+    assert occ["buckets"] and "padded_reduction_vs_legacy" in t
+
+
 def test_rseek_parser_defaults():
     args = get_parser().parse_args(["-f", "presto", "x.inf"])
     assert args.Pmin == 1.0 and args.Pmax == 10.0
