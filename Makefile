@@ -4,7 +4,7 @@ PYTHON ?= python
 
 .PHONY: install check check-full prove repin lint native-asan sanitize \
 	tests tests-cov native bench trace-demo report-demo watch-demo \
-	chaos clean
+	serve-demo chaos clean
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -41,8 +41,9 @@ repin:
 	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py --all
 
 # The CI form: AST analyzers uncached + the semantic pass + the fleet/
-# alert e2e acceptance (watch-demo).
-check-full: watch-demo
+# alert e2e acceptance (watch-demo) + the survey-service e2e
+# acceptance (serve-demo).
+check-full: watch-demo serve-demo
 	$(PYTHON) tools/riplint.py --no-cache
 	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py
 
@@ -124,6 +125,15 @@ report-demo:
 # check-full.
 watch-demo:
 	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) tools/watch_demo.py
+
+# Survey-service e2e acceptance (PR 16): two concurrent HTTP jobs
+# through one in-process rserve daemon must be byte-identical to
+# their batch-scheduler controls, a repeat-geometry job must run
+# with the exec_cold_builds counter flat (warm executables), and a
+# tools/rserve.py subprocess KILLED mid-job (exit 137) must resume
+# on restart to byte-identical peaks.csv. Wired into check-full.
+serve-demo:
+	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) tools/serve_demo.py
 
 # Storage-chaos campaign: a tiny CPU survey run as subprocess legs that
 # are KILLED mid-write at journal/ledger/cache boundaries (plus

@@ -89,6 +89,10 @@ WRITER_SPECS = (
     ("riptide_tpu/obs/chrome.py", "write_chrome_trace", "trace"),
     ("riptide_tpu/obs/chrome.py", "merge_chrome_traces", "trace"),
     ("riptide_tpu/search/engine.py", "device_fingerprint", "platform"),
+    # The survey service's job-registry event (PR 16): the ONE builder
+    # of jobs.jsonl records, consumed by report.py's job table, rtop's
+    # serve view and the daemon's own restart replay.
+    ("riptide_tpu/serve/daemon.py", "job_record", "job"),
 )
 
 # (relpath, function qual or None = whole module) of the CONSUMPTION

@@ -107,10 +107,108 @@ def get_parser():
         "and the padded-work reduction vs the legacy layout) as JSON "
         "and exit without searching",
     )
+    parser.add_argument(
+        "--submit", type=str, default=None, metavar="URL",
+        help="Submit the search as a job to a running rserve daemon "
+        "(e.g. http://127.0.0.1:9117) instead of searching locally; "
+        "polls until the job finishes and prints its peaks CSV. The "
+        "daemon keeps executables warm, so repeat geometries skip "
+        "compilation entirely",
+    )
+    parser.add_argument(
+        "--tenant", type=str, default="default",
+        help="Tenant name for --submit (fair-share + quota accounting)",
+    )
+    parser.add_argument(
+        "--priority", type=int, default=0,
+        help="Job priority for --submit (lower runs first)",
+    )
     parser.add_argument("fname", type=str,
                         help="Path of the time series file to search")
     parser.add_argument("--version", action="version", version=__version__)
     return parser
+
+
+def _http_json(url, method="GET", body=None, timeout=10.0):
+    """One loopback request to the service; returns (code, parsed doc or
+    raw text). Stdlib-only — the submit client must work without jax."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    data = _json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            code = resp.status
+    except urllib.error.HTTPError as err:
+        raw = err.read()
+        code = err.code
+    text = raw.decode("utf-8", "replace")
+    try:
+        return code, _json.loads(text)
+    except ValueError:
+        return code, text
+
+
+def run_submit(args, poll_s=0.25, timeout_s=600.0):
+    """The --submit client: POST the search as a service job, poll it
+    to completion, print (and return) its peaks CSV text. Raises
+    RuntimeError when the service rejects or fails the job."""
+    import os
+    import time as _time
+
+    base = args.submit.rstrip("/")
+    spec = {
+        "files": [os.path.abspath(args.fname)],
+        "fmt": args.format,
+        "tenant": args.tenant,
+        "priority": args.priority,
+        "deredden": {"rmed_width": args.rmed_width,
+                     "rmed_minpts": args.rmed_minpts},
+        "search": [{
+            "ffa_search": {
+                "period_min": args.Pmin, "period_max": args.Pmax,
+                "bins_min": args.bmin, "bins_max": args.bmax,
+                "wtsp": args.wtsp,
+            },
+            "find_peaks": {"smin": args.smin, "clrad": args.clrad},
+        }],
+    }
+    if args.fault_inject:
+        spec["fault_inject"] = args.fault_inject
+    code, doc = _http_json(base + "/jobs", method="POST", body=spec)
+    if code != 202:
+        raise RuntimeError(f"submit rejected ({code}): {doc}")
+    jid = doc["job_id"]
+    log.info("submitted %s to %s (warm_start pending)", jid, base)
+    deadline = _time.monotonic() + timeout_s
+    while True:
+        code, doc = _http_json(f"{base}/jobs/{jid}")
+        status = doc.get("status") if code == 200 else None
+        if status in ("done", "failed", "cancelled"):
+            break
+        if _time.monotonic() > deadline:
+            raise RuntimeError(f"{jid}: still {status!r} after "
+                               f"{timeout_s:.0f}s")
+        _time.sleep(poll_s)
+    if status != "done":
+        raise RuntimeError(
+            f"{jid}: {status} ({doc.get('error', 'no error detail')})")
+    code, csv_text = _http_json(f"{base}/jobs/{jid}/peaks")
+    if code != 200:
+        raise RuntimeError(f"{jid}: peaks fetch failed ({code}): "
+                           f"{csv_text}")
+    print(f"# job {jid} done: {doc.get('npeaks', 0)} peak(s), "
+          f"device {doc.get('device_s', 0)}s, "
+          f"queue wait {doc.get('queue_wait_s', 0)}s, "
+          f"warm_start={doc.get('warm_start')}")
+    if isinstance(csv_text, str) and csv_text:
+        print(csv_text, end="" if csv_text.endswith("\n") else "\n")
+    return csv_text
 
 
 def _search_peaks(args, ts):
@@ -257,6 +355,13 @@ def run_program(args):
     (columns period/freq/width/ducy/dm/snr), or None if nothing
     significant was found.
     """
+    if getattr(args, "submit", None):
+        # Client mode: the search runs inside the rserve daemon; this
+        # process never imports jax.
+        logging.basicConfig(level="INFO")
+        run_submit(args)
+        return None
+
     import pandas
 
     from riptide_tpu import TimeSeries

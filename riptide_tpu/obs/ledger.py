@@ -53,6 +53,14 @@ LEDGER_VERSION = 1
 FINGERPRINT_EXCLUDE = frozenset({
     "RIPTIDE_LEDGER", "RIPTIDE_PROM_PORT", "RIPTIDE_PROM_TEXTFILE",
     "RIPTIDE_STATUS", "RIPTIDE_STATUS_STALE_S",
+    # Serve-plane knobs: where the daemon listens and how it admits
+    # jobs cannot affect a survey's measured perf, and excluding them
+    # keeps a service-run job's row fingerprint-equal to the same
+    # survey run as a batch CLI — the rreport --compare parity the
+    # service contract promises.
+    "RIPTIDE_SERVE", "RIPTIDE_SERVE_MAX_JOBS",
+    "RIPTIDE_SERVE_QUOTA_DEVICE_S", "RIPTIDE_SERVE_PORT",
+    "RIPTIDE_SERVE_DIR",
 })
 
 

@@ -47,12 +47,12 @@ log = logging.getLogger("riptide_tpu.obs.prom")
 
 __all__ = ["render", "write_prom", "serve", "maybe_serve",
            "maybe_write_textfile", "set_status_provider",
-           "set_fleet_source", "status_snapshot", "health_check",
-           "PROM_PREFIX", "ENDPOINTS"]
+           "set_fleet_source", "set_jobs_api", "status_snapshot",
+           "health_check", "PROM_PREFIX", "ENDPOINTS"]
 
 # Every path the daemon answers; the 404 body enumerates them so a
 # mistyped scrape target is self-diagnosing.
-ENDPOINTS = ("/", "/metrics", "/status", "/healthz")
+ENDPOINTS = ("/", "/metrics", "/status", "/healthz", "/jobs")
 
 PROM_PREFIX = "riptide"
 
@@ -327,6 +327,33 @@ def health_check(status=None, stale_s=None):
     return (not problems), problems
 
 
+# Process-wide jobs API: the survey service daemon
+# (riptide_tpu.serve.daemon) registers itself here so the SAME stdlib
+# endpoint that already serves /metrics /status /healthz also carries
+# the /jobs surface (submit / list / inspect / cancel / fetch peaks).
+# With none registered — every non-service process — /jobs answers 503,
+# and the GET-only endpoints behave exactly as before.
+_jobs_api = None
+_jobs_lock = threading.Lock()
+
+
+def set_jobs_api(api):
+    """Install the survey service's job API (None uninstalls); returns
+    the previous one. The api object answers ``submit(payload)``,
+    ``list()``, ``get(job_id)``, ``cancel(job_id)`` and
+    ``peaks_csv(job_id)`` — all but ``list`` returning
+    ``(http_code, document)`` (see riptide_tpu.serve.daemon)."""
+    global _jobs_api
+    with _jobs_lock:
+        prev, _jobs_api = _jobs_api, api
+    return prev
+
+
+def _current_jobs_api():
+    with _jobs_lock:
+        return _jobs_api
+
+
 class _PromServer:
     """Localhost metrics/status endpoint on a daemon thread.
     ``close()`` is idempotent; ``port`` is the bound port (useful with
@@ -367,6 +394,8 @@ class _PromServer:
                                     "status": status}),
                         "application/json",
                     )
+                elif path == "/jobs" or path.startswith("/jobs/"):
+                    self._jobs(path, "GET")
                 else:
                     self._reply(
                         404,
@@ -374,6 +403,76 @@ class _PromServer:
                         + ", ".join(ENDPOINTS) + "\n",
                         "text/plain",
                     )
+
+            def do_POST(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?")[0]
+                if path != "/jobs":
+                    self._reply(404, json.dumps(
+                        {"error": f"POST {path!r} unsupported; "
+                                  "submit to /jobs"}),
+                        "application/json")
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    body = json.loads(raw.decode() or "{}")
+                except (ValueError, UnicodeDecodeError) as err:
+                    self._reply(400, json.dumps(
+                        {"error": f"bad JSON body: {err}"}),
+                        "application/json")
+                    return
+                self._jobs(path, "POST", body)
+
+            def do_DELETE(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?")[0]
+                if not path.startswith("/jobs/"):
+                    self._reply(404, json.dumps(
+                        {"error": f"DELETE {path!r} unsupported; "
+                                  "cancel via /jobs/<id>"}),
+                        "application/json")
+                    return
+                self._jobs(path, "DELETE")
+
+            def _jobs(self, path, method, body=None):
+                """One /jobs request against the installed jobs API
+                (503 when no service daemon has registered one)."""
+                api = _current_jobs_api()
+                if api is None:
+                    self._reply(503, json.dumps(
+                        {"error": "no survey service running here "
+                                  "(start one with tools/rserve.py)"}),
+                        "application/json")
+                    return
+                try:
+                    if method == "POST":
+                        code, doc = api.submit(body or {})
+                    elif method == "GET" and path == "/jobs":
+                        code, doc = 200, api.list()
+                    elif method == "GET" and path.endswith("/peaks"):
+                        job_id = path[len("/jobs/"):-len("/peaks")]
+                        code, doc = api.peaks_csv(job_id)
+                        if code == 200:
+                            # Raw CSV bytes, exactly as written to the
+                            # job directory (byte-identity is part of
+                            # the service contract).
+                            self.send_response(200)
+                            self.send_header("Content-Type", "text/csv")
+                            self.send_header("Content-Length",
+                                             str(len(doc)))
+                            self.end_headers()
+                            self.wfile.write(doc)
+                            return
+                    elif method == "GET":
+                        code, doc = api.get(path[len("/jobs/"):])
+                    elif method == "DELETE":
+                        code, doc = api.cancel(path[len("/jobs/"):])
+                    else:
+                        code, doc = 405, {"error": f"{method} {path}"}
+                except Exception as err:
+                    log.warning("jobs api failed for %s %s: %s",
+                                method, path, err)
+                    code, doc = 500, {"error": str(err)}
+                self._reply(code, json.dumps(doc), "application/json")
 
             def log_message(self, fmt, *args):
                 log.debug("prom endpoint: " + fmt, *args)

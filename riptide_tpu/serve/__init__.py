@@ -1,0 +1,15 @@
+"""
+Survey-as-a-service: a warm, multi-tenant daemon over the batch
+scheduler.
+
+``tools/rserve.py`` starts a :class:`~riptide_tpu.serve.daemon.
+ServeDaemon`; clients submit jobs over the existing loopback HTTP
+endpoint (``POST /jobs``) or with ``rseek --submit``. See
+``docs/survey_service.md``.
+"""
+from .daemon import GeometryPins, JobRegistry, ServeDaemon
+from .queue import FairShareQueue, JobCancelled, QuotaExceeded
+from .tenants import TenantTable
+
+__all__ = ["ServeDaemon", "JobRegistry", "GeometryPins", "FairShareQueue",
+           "TenantTable", "JobCancelled", "QuotaExceeded"]

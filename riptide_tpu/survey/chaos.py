@@ -128,6 +128,15 @@ def builtin_schedules():
                   {"faults": "", "resume": True, "cache_probe": True,
                    "cache_expect": "compiled", "cache_reload": True}],
          "incidents": ["cache_corrupt"]},
+        # The survey service (PR 16): the daemon runs in the leg
+        # process with the same survey submitted as an HTTP job; the
+        # armed kill drops the whole daemon mid-job, and the restart
+        # leg must replay jobs.jsonl, resume the job from its own
+        # journal and serve a byte-identical peaks.csv.
+        {"name": "serve-kill-mid-job", "serve": True,
+         "legs": [{"faults": "kill_at:journal_append:3", "expect": "kill"},
+                  {"faults": "", "resume": True}],
+         "incidents": ["storage_recovered"]},
     ]
 
 
@@ -199,6 +208,8 @@ def _run_leg(schedule, i, leg, paths, python, timeout_s):
         "cache_dir": paths["cache_dir"],
         "cache_expect": leg.get("cache_expect"),
         "cache_reload": bool(leg.get("cache_reload", False)),
+        "serve": bool(schedule.get("serve", False)),
+        "serve_root": paths.get("serve_root"),
     }
     cfg_path = os.path.join(paths["sdir"], f"leg{i}.json")
     with open(cfg_path, "w") as fobj:
@@ -218,6 +229,13 @@ def _run_leg(schedule, i, leg, paths, python, timeout_s):
     if leg.get("prom"):
         env["RIPTIDE_PROM_TEXTFILE"] = os.path.join(paths["sdir"],
                                                     "metrics.prom")
+    if cfg["serve"] and cfg["faults"]:
+        # Serve legs inject through the daemon's environment (the
+        # scheduler installs its own storage-fault hook per run, so a
+        # process-level hook can't reach it; and a fault spec in the
+        # job SPEC would persist in the registry and re-arm on the
+        # restart leg).
+        env["RIPTIDE_FAULT_INJECT"] = cfg["faults"]
     proc = subprocess.run(
         [python, "-m", "riptide_tpu.survey.chaos", "--leg", cfg_path],
         env=env, cwd=_repo_root(), capture_output=True, text=True,
@@ -352,6 +370,13 @@ def run_campaign(files, workdir, schedules=None, python=None,
             "cache_dir": os.path.join(sdir, "cache"),
             "files": [os.path.abspath(f) for f in files],
         }
+        if schedule.get("serve"):
+            paths["serve_root"] = os.path.join(sdir, "serve")
+            # A fresh registry's first job is deterministically j0001;
+            # its per-job journal directory is what the campaign's
+            # journal/ledger/incident invariants check.
+            paths["jdir"] = os.path.join(paths["serve_root"], "jobs",
+                                         "j0001")
         for i, leg in enumerate(schedule["legs"]):
             _run_leg(schedule, i, leg, paths, python, timeout_s)
             legs_run += 1
@@ -429,14 +454,71 @@ def _cache_probe(cache_dir, expect=None, reload_check=False):
                 f"{info['action']!r}")
 
 
+def _serve_leg_main(cfg):
+    """One SERVE-mode leg: the survey service daemon runs in this leg
+    process and the survey goes through it as a real HTTP job. The
+    leg's faults are armed through ``RIPTIDE_FAULT_INJECT``, set in
+    this leg's environment by the parent's :func:`_run_leg` (the
+    daemon passes the flag into each job's scheduler — a process-level
+    fsio hook would be overridden by the scheduler's own), so a
+    ``kill_at`` drops the WHOLE daemon mid-job; the next leg's restart
+    replays ``jobs.jsonl``, resumes the job from its own journal, and
+    must serve a peaks.csv byte-identical to the control run's."""
+    import time
+    import urllib.request
+
+    from ..serve import ServeDaemon
+
+    daemon = ServeDaemon(cfg["serve_root"], port=0, workers=1).start()
+    base = f"http://127.0.0.1:{daemon.port}"
+    unfinished = [d for d in daemon.list()["jobs"]
+                  if d.get("status") in ("pending", "running")]
+    if unfinished:
+        # Restart leg: start() already re-queued the interrupted job.
+        jid = unfinished[0]["job_id"]
+    else:
+        spec = {"files": cfg["files"], "fmt": "presto",
+                "deredden": {"rmed_width": 4.0, "rmed_minpts": 101},
+                "search": SEARCH_CONF}
+        req = urllib.request.Request(
+            base + "/jobs", data=json.dumps(spec).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            jid = json.loads(resp.read())["job_id"]
+    deadline = time.monotonic() + 240.0
+    status = None
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(f"{base}/jobs/{jid}",
+                                    timeout=10.0) as resp:
+            status = json.loads(resp.read()).get("status")
+        if status in ("done", "failed", "cancelled"):
+            break
+        time.sleep(0.1)
+    if status != "done":
+        raise ChaosFailure(f"serve leg: job {jid} ended {status!r}")
+    with urllib.request.urlopen(f"{base}/jobs/{jid}/peaks",
+                                timeout=10.0) as resp:
+        payload = resp.read()
+    with open(cfg["peaks_csv"], "wb") as fobj:
+        fobj.write(payload)
+    daemon.stop()
+    return 0
+
+
 def _leg_main(cfg_path):
     """One subprocess leg: install the leg's fault plan into fsio and
     the journal as the incident sink, optionally probe the exec cache,
     run the tiny survey through the checkpointed scheduler, and write
     peaks.csv. Exits by returning 0 — unless an armed ``kill_at``
-    hard-exits mid-write first, which is the point."""
+    hard-exits mid-write first, which is the point. Serve-mode legs
+    (``cfg["serve"]``) run the survey through the service daemon
+    instead — see :func:`_serve_leg_main`."""
     with open(cfg_path) as fobj:
         cfg = json.load(fobj)
+
+    if cfg.get("serve"):
+        logging.basicConfig(level="INFO")
+        return _serve_leg_main(cfg)
 
     from ..obs import trace
     from ..pipeline.batcher import BatchSearcher

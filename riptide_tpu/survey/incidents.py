@@ -59,6 +59,9 @@ INCIDENT_KINDS = (
     "cache_corrupt",      # exec cache: corrupt entry evicted + rebuilt
     "alert_fired",        # obs.alerts: a rule started firing
     "alert_resolved",     # obs.alerts: a firing rule cleared
+    "job_rejected",       # serve: admission refused (capacity/quota)
+    "quota_exceeded",     # serve: tenant device-seconds budget exhausted
+    "job_cancelled",      # serve: job cancelled at a chunk boundary
 )
 
 _lock = threading.Lock()

@@ -331,12 +331,23 @@ class SurveyScheduler:
         ``alert_resolved`` incident + prom gauge). Default: built from
         ``RIPTIDE_ALERT_RULES`` when ``RIPTIDE_ALERTS`` is on and the
         run is journaled.
+    chunk_gate : object or None
+        Serve-mode yield point (``riptide_tpu.serve.queue``): an object
+        with ``begin(chunk_id)`` / ``end(chunk_id)``. ``begin`` is
+        called before every chunk's device dispatch and may BLOCK until
+        this survey's fair-share turn, or raise (``JobCancelled`` /
+        ``QuotaExceeded``) to stop the run at the chunk boundary — the
+        only interruption point, so the journal is always left
+        resumable. ``end`` is called when the chunk's turn is over
+        (success, park, or failure alike). None (the default) keeps
+        batch behaviour: no gating, zero overhead.
     """
 
     def __init__(self, searcher, chunks, journal=None, resume=False,
                  retry=None, faults=None, survey_id=None, metrics=None,
                  watchdog=None, breaker=None, monitor=None,
-                 process_index=0, fleet_dir=None, alerts=None):
+                 process_index=0, fleet_dir=None, alerts=None,
+                 chunk_gate=None):
         self.searcher = searcher
         self.chunks = [list(c) for c in chunks]
         self.journal = journal
@@ -352,6 +363,7 @@ class SurveyScheduler:
         self.process_index = int(process_index)
         self.fleet_dir = fleet_dir
         self.alerts = alerts
+        self.chunk_gate = chunk_gate
         if survey_id is None:
             survey_id = survey_identity([f for c in self.chunks for f in c])
         self.survey_id = survey_id
@@ -774,67 +786,85 @@ class SurveyScheduler:
                         pending[k + 1],
                     )
                 self._heartbeat_safe()
-                if self.breaker is not None and not self.breaker.allow():
-                    self._park(cid, f"circuit {self.breaker.state}")
-                    self._fleet_safe()
-                    self._alerts_safe()
-                    continue
-                self._in_flight = cid
-                t0 = time.perf_counter()
-                self.faults.corrupt_wire(cid, items)
+                if self.chunk_gate is not None:
+                    # Serve-mode yield point: block for this survey's
+                    # fair-share turn on the device. A cancellation or
+                    # quota stop raises HERE — between chunks, after
+                    # the previous chunk's journal write — so the
+                    # journal is always left resumable.
+                    self.chunk_gate.begin(cid)
                 try:
-                    peaks, parts, attempts, digest = \
-                        self._dispatch_with_retry(cid, tslist, items,
-                                                  digest)
-                except (KeyboardInterrupt, SystemExit, FaultAbort):
-                    raise
-                except Exception as err:
-                    if self.breaker is None:
+                    if self.breaker is not None \
+                            and not self.breaker.allow():
+                        self._park(cid, f"circuit {self.breaker.state}")
+                        self._fleet_safe()
+                        self._alerts_safe()
+                        continue
+                    self._in_flight = cid
+                    t0 = time.perf_counter()
+                    self.faults.corrupt_wire(cid, items)
+                    try:
+                        peaks, parts, attempts, digest = \
+                            self._dispatch_with_retry(cid, tslist, items,
+                                                      digest)
+                    except (KeyboardInterrupt, SystemExit, FaultAbort):
                         raise
-                    # Breaker configured: a chunk that exhausted its
-                    # retries parks instead of aborting the survey.
-                    self.breaker.record_failure()
-                    self._park(cid, f"dispatch failed after retries: {err}")
+                    except Exception as err:
+                        if self.breaker is None:
+                            raise
+                        # Breaker configured: a chunk that exhausted its
+                        # retries parks instead of aborting the survey.
+                        self.breaker.record_failure()
+                        self._park(cid,
+                                   f"dispatch failed after retries: {err}")
+                        self._fleet_safe()
+                        self._alerts_safe()
+                        continue
+                    finally:
+                        self._in_flight = None
+                    if self.breaker is not None:
+                        self.breaker.record_success()
+                    chunk_s = time.perf_counter() - t0
+                    self.metrics.observe("chunk_s", chunk_s)
+                    self.metrics.add("chunks_done")
+                    peaks_by_chunk[cid] = peaks
+                    timing = chunk_timing(chunk_s, prep_s=prep_s, **parts)
+                    self._run_timings.append(timing)
+                    if self.journal is not None:
+                        dq = {}
+                        if hasattr(self.searcher, "chunk_dq_summary"):
+                            dq = self.searcher.chunk_dq_summary(
+                                self.chunks[cid])
+                        # Predicted-vs-actual peak HBM next to the timing
+                        # block (empty while model seeding is off): the
+                        # calibration record of the jaxpr-contract model,
+                        # surfaced by rreport's hbm section.
+                        hbm = {}
+                        if hasattr(self.searcher, "chunk_hbm_block"):
+                            hbm = self.searcher.chunk_hbm_block(items) or {}
+                        with span("journal", chunk=cid):
+                            self.journal.record_chunk(
+                                cid, self.chunks[cid],
+                                [float(ts.metadata["dm"] or 0.0)
+                                 for ts in tslist],
+                                peaks, wire_digest=digest,
+                                timings=timing, attempts=attempts, dq=dq,
+                                hbm=hbm,
+                            )
+                    # Per-chunk fleet publication + live alert evaluation
+                    # (both no-ops while their flags are off, both
+                    # never-fatal): the measure→detect half of the loop.
                     self._fleet_safe()
                     self._alerts_safe()
-                    continue
+                    log.debug("chunk %d/%d done: %d peaks, %d attempt(s)",
+                              cid + 1, len(self.chunks), len(peaks),
+                              attempts)
                 finally:
-                    self._in_flight = None
-                if self.breaker is not None:
-                    self.breaker.record_success()
-                chunk_s = time.perf_counter() - t0
-                self.metrics.observe("chunk_s", chunk_s)
-                self.metrics.add("chunks_done")
-                peaks_by_chunk[cid] = peaks
-                timing = chunk_timing(chunk_s, prep_s=prep_s, **parts)
-                self._run_timings.append(timing)
-                if self.journal is not None:
-                    dq = {}
-                    if hasattr(self.searcher, "chunk_dq_summary"):
-                        dq = self.searcher.chunk_dq_summary(self.chunks[cid])
-                    # Predicted-vs-actual peak HBM next to the timing
-                    # block (empty while model seeding is off): the
-                    # calibration record of the jaxpr-contract model,
-                    # surfaced by rreport's hbm section.
-                    hbm = {}
-                    if hasattr(self.searcher, "chunk_hbm_block"):
-                        hbm = self.searcher.chunk_hbm_block(items) or {}
-                    with span("journal", chunk=cid):
-                        self.journal.record_chunk(
-                            cid, self.chunks[cid],
-                            [float(ts.metadata["dm"] or 0.0)
-                             for ts in tslist],
-                            peaks, wire_digest=digest,
-                            timings=timing, attempts=attempts, dq=dq,
-                            hbm=hbm,
-                        )
-                # Per-chunk fleet publication + live alert evaluation
-                # (both no-ops while their flags are off, both
-                # never-fatal): the measure→detect half of the loop.
-                self._fleet_safe()
-                self._alerts_safe()
-                log.debug("chunk %d/%d done: %d peaks, %d attempt(s)",
-                          cid + 1, len(self.chunks), len(peaks), attempts)
+                    # The turn is over whether the chunk completed,
+                    # parked, or failed: the gate measures begin→end to
+                    # charge the tenant's device-seconds budget.
+                    if self.chunk_gate is not None:
+                        self.chunk_gate.end(cid)
         self.metrics.set_gauge("queue_depth", 0)
         # One closing evaluation over the final journal state, so a
         # condition that cleared on the last chunk still resolves

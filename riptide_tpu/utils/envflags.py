@@ -316,6 +316,47 @@ _ALL = [
         "exactly `1` enables; everything else disables.",
         since="seed", scope="tools",
     ),
+    EnvFlag(
+        "RIPTIDE_SERVE", "bool", True,
+        "Serve the /jobs API from the survey service daemon "
+        "(tools/rserve.py): accept, queue and run jobs submitted over "
+        "HTTP. `0` starts the daemon metrics/status-only (the /jobs "
+        "surface answers 503) — a drain mode for maintenance.",
+        since="PR 16 (0.15.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_SERVE_MAX_JOBS", "int", 16,
+        "Max jobs the service daemon keeps resident (pending + "
+        "running) across ALL tenants; a submit over the cap is "
+        "rejected with HTTP 429 and a `job_rejected` incident. "
+        "Completed/failed/cancelled jobs do not count.",
+        since="PR 16 (0.15.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_SERVE_QUOTA_DEVICE_S", "float", 0.0,
+        "Default per-tenant device-seconds budget for service jobs "
+        "(riptide_tpu/serve/tenants.py): every fair-share device turn "
+        "is charged against it, and an exhausted tenant's jobs stop at "
+        "their next chunk boundary with a `quota_exceeded` incident "
+        "(journals stay resumable). `0` = unlimited.",
+        since="PR 16 (0.15.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_SERVE_PORT", "int", 0,
+        "Port of the survey service daemon's HTTP endpoint "
+        "(tools/rserve.py; loopback only, like RIPTIDE_PROM_PORT). "
+        "`0` binds an ephemeral port, published in the serve root's "
+        "`serve.port` discovery file either way.",
+        since="PR 16 (0.15.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_SERVE_DIR", "str", None,
+        "Default serve root for tools/rserve.py (the directory holding "
+        "jobs.jsonl, per-job journal directories and the serve.port "
+        "discovery file). Unset = the rserve --root argument is "
+        "required.",
+        since="PR 16 (0.15.0)",
+    ),
 ]
 
 FLAGS = {f.name: f for f in _ALL}

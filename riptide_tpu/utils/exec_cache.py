@@ -375,11 +375,26 @@ def _is_array(a):
     return hasattr(a, "shape") and hasattr(a, "dtype")
 
 
+def _bump_warmth(fresh):
+    """Count one executable use as cold (first sighting of its key on
+    this wrapper) or warm. Best-effort: metrics live in the survey
+    layer (imported lazily to keep utils dependency-free), and a
+    failure to count must never fail the call being counted."""
+    try:
+        from ..survey.metrics import get_metrics
+        get_metrics().add("exec_cold_builds" if fresh else "exec_warm_hits")
+    except Exception:
+        pass
+
+
 class _Cached:
     def __init__(self, jitted, name):
         self.jitted = jitted
         self.name = name
         self._mem = {}
+        # Keys this wrapper has already served: the warm/cold split the
+        # serve daemon's warm-start assertion reads (see __call__).
+        self._seen = set()
 
     def _key(self, flat_args):
         parts = [self.name, _src_hash(), jax.devices()[0].platform,
@@ -411,10 +426,27 @@ class _Cached:
         return functools.partial(self.__call__, obj)
 
     def __call__(self, *args, **kw):
-        if not _on_tpu() or envflags.get("RIPTIDE_EXEC_CACHE") == "off":
-            return self.jitted(*args, **kw)
         flat = list(args) + [kw[k] for k in sorted(kw)]
-        key = self._key(flat)
+        # Warm/cold accounting on EVERY backend: the first call with a
+        # given key is a cold build (jax.jit trace+compile, or an AOT
+        # compile on TPU); later calls reuse the live executable. On
+        # CPU — where the disk cache below is bypassed — jax.jit's
+        # in-process cache provides the same reuse, so a long-lived
+        # daemon's warm-start claim (`exec_cold_builds` flat across a
+        # same-geometry job) is assertable in CPU CI.
+        try:
+            key = self._key(flat)
+        except Exception:
+            key = None
+        if key is not None:
+            with _lock:
+                fresh = key not in self._seen
+                if fresh:
+                    self._seen.add(key)
+            _bump_warmth(fresh)
+        if not _on_tpu() or envflags.get("RIPTIDE_EXEC_CACHE") == "off" \
+                or key is None:
+            return self.jitted(*args, **kw)
         fn = self._mem.get(key)
         if fn is None:
             with _lock:

@@ -71,6 +71,7 @@ SITES = (
     "prom_textfile",      # Prometheus textfile page
     "exec_cache_store",   # compiled-executable cache entries
     "fleet_snapshot",     # fleet_<p>.json per-process status sidecars
+    "job_append",         # jobs.jsonl service job-registry events
 )
 
 _HEX = frozenset(b"0123456789abcdef")

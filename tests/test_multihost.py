@@ -16,8 +16,16 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from riptide_tpu.peak_detection import Peak
+
+# The marker a worker's XlaRuntimeError carries when the installed
+# jaxlib build cannot run real multi-process collectives on the CPU
+# backend (environment limitation, not a code defect — skip, don't
+# fail).
+_BACKEND_UNSUPPORTED = \
+    "Multiprocess computations aren't implemented on the CPU backend"
 
 _WORKER = r"""
 import os, sys
@@ -114,6 +122,11 @@ def _run_two_processes(tmp_path, source, extra_args=()):
 def test_two_process_distributed_search(tmp_path):
     results = _run_two_processes(tmp_path, _WORKER)
     for i, (rc, out) in enumerate(results):
+        if rc != 0 and _BACKEND_UNSUPPORTED in out:
+            # Some jaxlib builds refuse real multi-process collectives
+            # on the forced-host CPU backend; nothing to test there.
+            pytest.skip("multiprocess collectives unsupported on this "
+                        "CPU backend build")
         assert rc == 0, f"worker {i} failed:\n{out[-4000:]}"
         assert f"worker {i} OK" in out
 

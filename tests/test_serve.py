@@ -1,0 +1,409 @@
+"""
+Survey-as-a-service tests: the rserve daemon (riptide_tpu/serve) over
+REAL loopback HTTP — lifecycle, fair-share interleaving of concurrent
+jobs, quota enforcement, chunk-boundary cancellation, warm-executable
+reuse across jobs, and registry-replay restart recovery. The daemon
+runs in-process (the subprocess kill/restart variant lives in the
+chaos campaign's ``serve-kill-mid-job`` schedule); compiled
+executables are process-wide, so the first searched job pays the CPU
+compile once and every later test in this module runs warm.
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from synth import generate_data_presto
+from riptide_tpu.serve import ServeDaemon, FairShareQueue, TenantTable
+from riptide_tpu.serve.daemon import (
+    fold_job_events, geometry_key, job_record,
+)
+from riptide_tpu.serve.queue import JobCancelled, QuotaExceeded
+from riptide_tpu.survey import incidents
+from riptide_tpu.survey.journal import SurveyJournal
+from riptide_tpu.survey.metrics import get_metrics
+
+# The chaos campaign's tiny deterministic survey (CPU-fast; one
+# compile for the whole module).
+TOBS, TSAMP, PERIOD = 12.0, 1e-3, 0.5
+DMS = (0.0, 5.0, 10.0)
+
+SEARCH = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+
+@pytest.fixture(scope="module")
+def data_files(tmp_path_factory):
+    outdir = tmp_path_factory.mktemp("serve_data")
+    return [
+        generate_data_presto(str(outdir), f"s_DM{dm:.2f}", tobs=TOBS,
+                             tsamp=TSAMP, period=PERIOD, dm=dm,
+                             amplitude=30.0)
+        for dm in DMS
+    ]
+
+
+def _spec(files, tenant="default", priority=0):
+    return {"files": list(files), "fmt": "presto", "tenant": tenant,
+            "priority": priority,
+            "deredden": {"rmed_width": 4.0, "rmed_minpts": 101},
+            "search": SEARCH}
+
+
+def _req(base, path, method="GET", body=None, timeout=10.0):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        base + path, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+def _req_json(base, path, method="GET", body=None):
+    code, raw = _req(base, path, method=method, body=body)
+    return code, json.loads(raw)
+
+
+def _wait_terminal(base, jid, timeout_s=180.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        code, doc = _req_json(base, f"/jobs/{jid}")
+        assert code == 200, doc
+        if doc.get("status") in ("done", "failed", "cancelled"):
+            return doc
+        time.sleep(0.1)
+    raise AssertionError(f"{jid} did not finish within {timeout_s}s")
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    started = []
+
+    def _start(**kw):
+        kw.setdefault("port", 0)
+        d = ServeDaemon(str(tmp_path / "serve"), **kw).start()
+        started.append(d)
+        return d, f"http://127.0.0.1:{d.port}"
+
+    yield _start
+    for d in started:
+        d.stop()
+
+
+# ------------------------------------------------------------- unit layer
+
+def test_fold_job_events_lifecycle():
+    recs = [
+        job_record("j0001", "submitted", tenant="a", priority=2,
+                   spec={"search": SEARCH}),
+        job_record("j0001", "started"),
+        job_record("j0001", "done", npeaks=4, device_s=1.5,
+                   queue_wait_s=0.1, chunks_total=3),
+        job_record("j0002", "submitted", tenant="b"),
+    ]
+    jobs = fold_job_events(recs)
+    assert jobs["j0001"]["status"] == "done"
+    assert jobs["j0001"]["tenant"] == "a"
+    assert jobs["j0001"]["priority"] == 2
+    assert jobs["j0001"]["npeaks"] == 4
+    assert jobs["j0001"]["chunks_total"] == 3
+    assert jobs["j0002"]["status"] == "pending"
+    # Garbage and foreign kinds fold to nothing.
+    assert fold_job_events([{"kind": "chunk"}, "junk", None]) == {}
+
+
+def test_geometry_key_canonical():
+    a = _spec(["x.inf"])
+    b = _spec(["y.inf"], tenant="other")  # data/tenant don't change it
+    assert geometry_key(a) == geometry_key(b)
+    c = dict(a, search=[{"ffa_search": {"period_min": 0.4}}])
+    assert geometry_key(a) != geometry_key(c)
+
+
+def test_fair_share_queue_pick_order():
+    q = FairShareQueue()
+    q.register("a1", tenant="a")
+    q.register("a2", tenant="a")
+    q.register("b1", tenant="b")
+    # Simulate accumulated device time: tenant a has consumed more, so
+    # b's waiting job must win the next turn; priority trumps both.
+    q._tenant_device_s["a"] = 5.0
+    q._tenant_device_s["b"] = 1.0
+    for jid in ("a1", "a2", "b1"):
+        q._entries[jid].waiting = True
+    assert q._pick().job_id == "b1"
+    q.register("a0", tenant="a", priority=-1)
+    q._entries["a0"].waiting = True
+    assert q._pick().job_id == "a0"
+
+
+def test_queue_cancel_raises_at_begin():
+    q = FairShareQueue()
+    gate = q.register("j1")
+    q.cancel("j1")
+    with pytest.raises(JobCancelled):
+        gate.begin(0)
+
+
+def test_tenant_quota_admission_and_budget():
+    t = TenantTable(budget_device_s=2.0, max_active=1)
+    ok, _ = t.admit("a")
+    assert ok
+    t.job_started("a")
+    ok, reason = t.admit("a")
+    assert not ok and "max active" in reason
+    t.job_finished("a")
+    t.charge("a", 2.5)
+    assert t.exhausted("a")
+    ok, reason = t.admit("a")
+    assert not ok and "budget exhausted" in reason
+    assert t.remaining("a") == 0.0
+    # Unlimited tenant budget (0) never exhausts.
+    t2 = TenantTable(budget_device_s=0.0)
+    t2.charge("a", 1e9)
+    assert not t2.exhausted("a")
+    q = FairShareQueue(tenants=t)
+    gate = q.register("j1", tenant="a")
+    with pytest.raises(QuotaExceeded):
+        gate.begin(0)
+
+
+# ----------------------------------------------------------- service layer
+
+def test_job_lifecycle_over_http(daemon, data_files):
+    d, base = daemon(workers=1)
+    code, doc = _req_json(base, "/jobs", "POST", _spec(data_files[:1]))
+    assert code == 202, doc
+    jid = doc["job_id"]
+    assert doc["status"] == "pending"
+    doc = _wait_terminal(base, jid)
+    assert doc["status"] == "done", doc.get("error")
+    assert doc["npeaks"] > 0
+    assert doc["chunks_total"] == 1
+    assert doc["device_s"] > 0
+    assert doc["queue_wait_s"] >= 0
+    # The served CSV is byte-identical to the job directory's product.
+    code, payload = _req(base, f"/jobs/{jid}/peaks")
+    assert code == 200
+    with open(os.path.join(doc["directory"], "peaks.csv"), "rb") as fobj:
+        assert payload == fobj.read()
+    assert payload.startswith(b"period,")
+    # Listing carries the job plus the quota/queue/pin surfaces.
+    code, listing = _req_json(base, "/jobs")
+    assert code == 200
+    assert [j["job_id"] for j in listing["jobs"]] == [jid]
+    assert "default" in listing["tenants"]
+    assert listing["geometry_pins"]
+    # Unknown job and not-done peaks answer with proper codes.
+    assert _req_json(base, "/jobs/j9999")[0] == 404
+    code, _ = _req_json(base, "/jobs", "POST", {"search": SEARCH})
+    assert code == 400  # no input files
+    # The job's artifacts are ordinary survey artifacts: its own
+    # journal replays like any batch run's.
+    j = SurveyJournal(os.path.join(d.root, "jobs", jid))
+    assert sorted(j.completed_chunks()) == [0]
+
+
+def test_concurrent_jobs_fair_share_interleaving(daemon, data_files):
+    d, base = daemon(workers=2)
+    specs = [_spec(data_files, tenant="alice"),
+             _spec(data_files, tenant="bob")]
+    jids = []
+    for spec in specs:
+        code, doc = _req_json(base, "/jobs", "POST", spec)
+        assert code == 202, doc
+        jids.append(doc["job_id"])
+    docs = [_wait_terminal(base, jid) for jid in jids]
+    assert all(doc["status"] == "done" for doc in docs)
+    # Journal-timestamp interleaving: merge both jobs' chunk records by
+    # their journaled utc stamps — the fair-share gate must alternate
+    # device turns between the tenants rather than running one job to
+    # completion first.
+    stamped = []
+    for jid in jids:
+        j = SurveyJournal(os.path.join(d.root, "jobs", jid))
+        for cid, (rec, _peaks) in j.completed_chunks().items():
+            stamped.append((rec["utc"], jid, cid))
+        assert sorted(cid for cid, _ in j.completed_chunks().items()) \
+            == [0, 1, 2]
+    stamped.sort()
+    order = [jid for _, jid, _ in stamped]
+    switches = sum(1 for a, b in zip(order, order[1:]) if a != b)
+    assert switches >= 2, f"no fair-share interleaving: {order}"
+    # Both tenants show up in the device-time accounting.
+    code, listing = _req_json(base, "/jobs")
+    assert set(listing["tenants"]) >= {"alice", "bob"}
+    assert all(v["device_s_spent"] > 0
+               for k, v in listing["tenants"].items()
+               if k in ("alice", "bob"))
+
+
+def test_admission_rejection_and_incident(daemon, data_files):
+    captured = []
+    prev = incidents.set_sink(captured.append)
+    try:
+        # workers=0: jobs stay pending, so the resident-cap and
+        # per-tenant admission checks are deterministic.
+        d, base = daemon(workers=0, max_jobs=2,
+                         tenants=TenantTable(max_active=1))
+        code, doc = _req_json(base, "/jobs", "POST",
+                              _spec(data_files[:1], tenant="alice"))
+        assert code == 202
+        # Same tenant again: per-tenant max_active=1 refuses.
+        code, doc = _req_json(base, "/jobs", "POST",
+                              _spec(data_files[:1], tenant="alice"))
+        assert code == 429
+        assert "max active" in doc["error"]
+        # Another tenant still fits (resident 2/2)...
+        code, doc = _req_json(base, "/jobs", "POST",
+                              _spec(data_files[:1], tenant="bob"))
+        assert code == 202
+        # ...and the NEXT submit trips the daemon-wide resident cap.
+        code, doc = _req_json(base, "/jobs", "POST",
+                              _spec(data_files[:1], tenant="carol"))
+        assert code == 429
+        assert "max resident" in doc["error"]
+    finally:
+        incidents.set_sink(prev)
+    kinds = [rec["incident"] for rec in captured]
+    assert kinds.count("job_rejected") == 2
+
+
+def test_runtime_quota_stops_at_chunk_boundary(daemon, data_files):
+    captured = []
+    prev = incidents.set_sink(captured.append)
+    try:
+        tenants = TenantTable(budget_device_s=1e-6)
+        d, base = daemon(workers=1, tenants=tenants)
+        code, doc = _req_json(base, "/jobs", "POST",
+                              _spec(data_files, tenant="meter"))
+        assert code == 202
+        doc = _wait_terminal(base, doc["job_id"])
+    finally:
+        incidents.set_sink(prev)
+    # The first chunk's turn exhausts the micro-budget; the stop lands
+    # at the NEXT chunk boundary, so the journal keeps the completed
+    # chunk and stays resumable.
+    assert doc["status"] == "failed"
+    assert "budget exhausted" in doc["error"]
+    j = SurveyJournal(doc["directory"])
+    done = j.completed_chunks()
+    assert 0 < len(done) < len(DMS)
+    assert any(rec["incident"] == "quota_exceeded" for rec in captured)
+
+
+def _spin(predicate, timeout_s=120.0, tick=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(tick)
+    return False
+
+
+def test_cancellation_leaves_resumable_journal(daemon, data_files):
+    d, base = daemon(workers=1)
+    # Deterministic mid-job cancellation: a higher-priority queue entry
+    # (the "blocker") holds the device turn around the job's first
+    # chunk, so the job is provably frozen at a chunk boundary when the
+    # DELETE lands — no racing the (fast, warm) chunk wall-clock.
+    blocker = d.queue.register("blocker", priority=-1)
+    blocker.begin(0)  # hold the device before the job can start
+    code, doc = _req_json(base, "/jobs", "POST", _spec(data_files))
+    assert code == 202
+    jid = doc["job_id"]
+    jdir = os.path.join(d.root, "jobs", jid)
+    # The job parks waiting for its first turn...
+    assert _spin(lambda: d.queue.snapshot()["jobs"]
+                 .get(jid, {}).get("waiting"))
+    blocker.end(0)  # ...takes the device for chunk 0...
+    assert _spin(lambda: d.queue.snapshot()["active"] == jid)
+    # ...and the re-queued blocker wins the NEXT turn by priority, so
+    # the job freezes right after journaling chunk 0.
+    t = threading.Thread(target=lambda: blocker.begin(1), daemon=True)
+    t.start()
+    assert _spin(lambda: d.queue.snapshot()["active"] == "blocker")
+    code, doc = _req_json(base, f"/jobs/{jid}", "DELETE")
+    assert code in (200, 202), doc
+    doc = _wait_terminal(base, jid)
+    blocker.end(1)
+    d.queue.unregister("blocker")
+    assert doc["status"] == "cancelled"
+    # Chunk-boundary cancellation: the first chunk's journal record
+    # survives, the rest are still owed, nothing torn — resumable.
+    done = SurveyJournal(jdir).completed_chunks()
+    assert sorted(done) == [0]
+    assert _req_json(base, f"/jobs/{jid}/peaks")[0] == 409
+    # Cancelling a finished job is a 409 no-op.
+    assert _req_json(base, f"/jobs/{jid}", "DELETE")[0] == 409
+
+
+def test_second_job_runs_warm(daemon, data_files):
+    d, base = daemon(workers=1)
+    code, doc = _req_json(base, "/jobs", "POST", _spec(data_files[:1]))
+    assert code == 202
+    first = _wait_terminal(base, doc["job_id"])
+    assert first["status"] == "done"
+    cold_before = get_metrics().counter("exec_cold_builds")
+    code, doc = _req_json(base, "/jobs", "POST", _spec(data_files[:1]))
+    assert code == 202
+    second = _wait_terminal(base, doc["job_id"])
+    assert second["status"] == "done"
+    # Warm service contract: a repeat geometry compiles NOTHING — the
+    # cold-build counter stays flat while warm hits accrue, and the
+    # job document says so.
+    assert get_metrics().counter("exec_cold_builds") == cold_before
+    assert second["warm_start"] is True
+    code, listing = _req_json(base, "/jobs")
+    pin = listing["geometry_pins"][geometry_key(_spec(data_files[:1]))]
+    assert pin["jobs"] >= 2
+    # Same inputs, same geometry, same survey: identical products.
+    for name in ("peaks.csv",):
+        with open(os.path.join(first["directory"], name), "rb") as f1, \
+                open(os.path.join(second["directory"], name), "rb") as f2:
+            assert f1.read() == f2.read()
+
+
+def test_restart_requeues_unfinished_jobs(daemon, data_files):
+    # Daemon 1 accepts but never runs (workers=0), then stops: the
+    # submitted job survives only as jobs.jsonl events.
+    d1, base1 = daemon(workers=0)
+    code, doc = _req_json(base1, "/jobs", "POST", _spec(data_files[:1]))
+    assert code == 202
+    jid = doc["job_id"]
+    d1.stop()
+    # Daemon 2 on the same root replays the registry, re-queues the
+    # pending job and completes it — ids continue, not restart.
+    d2, base2 = daemon(workers=1)
+    assert d2.root == d1.root
+    doc = _wait_terminal(base2, jid)
+    assert doc["status"] == "done"
+    code, doc2 = _req_json(base2, "/jobs", "POST", _spec(data_files[:1]))
+    assert doc2["job_id"] != jid
+    code, payload = _req(base2, f"/jobs/{jid}/peaks")
+    assert code == 200 and payload.startswith(b"period,")
+
+
+def test_jobs_endpoint_without_daemon():
+    from riptide_tpu.obs import prom
+
+    server = prom.serve(0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        code, doc = _req_json(base, "/jobs")
+        assert code == 503
+        assert "no survey service" in doc["error"]
+        code, doc = _req_json(base, "/jobs", "POST", {})
+        assert code == 503
+    finally:
+        server.close()

@@ -106,6 +106,13 @@ def main(argv=None):
 
     rep = load_report_module()
     if not os.path.exists(os.path.join(args.journal, "journal.jsonl")):
+        if os.path.exists(os.path.join(args.journal, "jobs.jsonl")):
+            # A survey-service directory: group its artifacts per job
+            # (each job's own journal stays rreport-able at
+            # jobs/<id>/).
+            print("\n".join(rep.render_jobs_text(
+                rep.job_table(args.journal))))
+            return 0
         print(f"rreport: no journal.jsonl under {args.journal!r}",
               file=sys.stderr)
         return 2

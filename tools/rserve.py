@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""
+rserve: the survey-as-a-service daemon.
+
+Starts a :class:`riptide_tpu.serve.daemon.ServeDaemon` rooted at a
+serve directory and keeps it up until SIGTERM/SIGINT. Compiled
+executables stay warm across jobs for the life of the process — a
+second job with an already-served plan geometry starts its first
+chunk with zero cold builds (the point of running a daemon at all).
+
+Usage::
+
+    python tools/rserve.py --root DIR [--port N] [--workers N]
+        [--max-jobs N]
+
+* ``--root`` (or ``RIPTIDE_SERVE_DIR``): the serve directory —
+  ``jobs.jsonl`` registry, per-job ``jobs/<id>/`` run directories,
+  ``serve.port`` discovery file.
+* ``--port`` (or ``RIPTIDE_SERVE_PORT``, default 0 = ephemeral): the
+  loopback HTTP port; the bound port is printed and written to
+  ``<root>/serve.port`` either way.
+* ``--workers``: concurrent job runners (the fair-share queue still
+  grants one device turn at a time).
+
+Submit with ``rseek --submit http://127.0.0.1:<port>`` or raw HTTP
+(``POST /jobs``); see docs/survey_service.md. On restart the daemon
+replays ``jobs.jsonl`` and resumes every unfinished job from its own
+survey journal.
+"""
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, ".."))
+
+from riptide_tpu.serve import ServeDaemon  # noqa: E402 (path setup first)
+from riptide_tpu.utils import envflags  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="rserve", description="warm multi-tenant survey service")
+    ap.add_argument("--root", default=None,
+                    help="serve directory (default: RIPTIDE_SERVE_DIR)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="HTTP port (default: RIPTIDE_SERVE_PORT; "
+                         "0 = ephemeral)")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="concurrent job runner threads (default 2)")
+    ap.add_argument("--max-jobs", type=int, default=None,
+                    help="resident pending+running job cap "
+                         "(default: RIPTIDE_SERVE_MAX_JOBS)")
+    args = ap.parse_args(argv)
+
+    root = args.root or envflags.get("RIPTIDE_SERVE_DIR")
+    if not root:
+        ap.error("no serve directory: give --root or set "
+                 "RIPTIDE_SERVE_DIR")
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    daemon = ServeDaemon(root, port=args.port, max_jobs=args.max_jobs,
+                         workers=args.workers)
+    daemon.start()
+    print(f"rserve: listening on http://127.0.0.1:{daemon.port}/jobs "
+          f"(root {daemon.root})", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    try:
+        while not stop.wait(timeout=0.5):
+            pass
+    finally:
+        daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

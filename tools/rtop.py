@@ -24,6 +24,11 @@ the frame redraws every ``--interval`` seconds until Ctrl-C. Loads the
 jax-free reader standalone, so it runs anywhere the journal files are
 visible (e.g. over a shared filesystem while the survey runs on the
 TPU host).
+
+Pointed at a SERVE directory (one holding the survey service's
+``jobs.jsonl`` registry — see docs/survey_service.md) the frame shows
+the per-job table instead: tenant, status, chunk progress, queue wait
+and device seconds per job, grouped from each job's own journal.
 """
 import argparse
 import os
@@ -139,6 +144,28 @@ def render_frame(rep, journal_dir, now=None, follower=None,
     return "\n".join(lines) + "\n"
 
 
+def render_serve_frame(rep, serve_dir, now=None):
+    """One frame of the SERVICE view: pointing rtop at a serve
+    directory (one holding a ``jobs.jsonl`` registry) shows the per-job
+    table — tenant, status, chunk progress, queue wait, device seconds
+    — instead of a single survey's chunk view. Point it at a
+    ``jobs/<id>/`` subdirectory to watch one job's survey the ordinary
+    way."""
+    now = time.time() if now is None else now
+    rows = rep.job_table(serve_dir)
+    running = sum(1 for r in rows if r.get("status") == "running")
+    pending = sum(1 for r in rows if r.get("status") == "pending")
+    lines = [f"rtop — survey service ({os.path.abspath(serve_dir)})",
+             f"jobs: {len(rows)} total, {running} running, "
+             f"{pending} pending"]
+    lines.extend(rep.render_jobs_text(rows))
+    return "\n".join(lines) + "\n"
+
+
+def is_serve_dir(directory):
+    return os.path.exists(os.path.join(directory, "jobs.jsonl"))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="rtop",
@@ -161,15 +188,20 @@ def main(argv=None):
         print(f"rtop: {args.journal!r} is not a directory",
               file=sys.stderr)
         return 2
+    serve_mode = is_serve_dir(args.journal)
     if args.once:
-        sys.stdout.write(render_frame(rep, args.journal,
-                                      show_fleet=args.fleet))
+        sys.stdout.write(render_serve_frame(rep, args.journal)
+                         if serve_mode
+                         else render_frame(rep, args.journal,
+                                           show_fleet=args.fleet))
         return 0
-    follower = rep.JournalFollower(args.journal)
+    follower = None if serve_mode else rep.JournalFollower(args.journal)
     try:
         while True:
-            frame = render_frame(rep, args.journal, follower=follower,
-                                 show_fleet=args.fleet)
+            frame = (render_serve_frame(rep, args.journal) if serve_mode
+                     else render_frame(rep, args.journal,
+                                       follower=follower,
+                                       show_fleet=args.fleet))
             # Clear + home, then the frame: a flicker-free-enough
             # redraw without a curses dependency.
             sys.stdout.write("\x1b[2J\x1b[H" + frame)

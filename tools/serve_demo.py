@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""
+serve-demo: end-to-end acceptance of the survey service (PR 16) — the
+warm, multi-tenant rserve daemon proven live on the CPU backend.
+
+Three legs:
+
+1. **batch controls** — the demo's two input sets run through the
+   ordinary in-process :class:`SurveyScheduler`; their ``peaks.csv``
+   bytes are the references every service job is compared against.
+2. **concurrent + warm service** — one in-process
+   :class:`ServeDaemon`: two jobs from two tenants submitted
+   back-to-back over real loopback HTTP run CONCURRENTLY through the
+   fair-share chunk gate, and each job's served CSV must be
+   byte-identical to its batch control. Then a third job repeating the
+   first's plan geometry must run with the ``exec_cold_builds``
+   counter FLAT (zero recompiles — the warm-executable pin), report
+   ``warm_start`` in its job document, and reproduce the control bytes
+   a third time. The ``rtop`` serve frame and ``rreport``'s job table
+   render the registry.
+3. **kill/restart recovery** — a ``tools/rserve.py`` SUBPROCESS with a
+   kill fault injected at a journal append boundary
+   (``RIPTIDE_FAULT_INJECT=kill_at:journal_append:3``) dies with exit
+   137 mid-job; a clean restart on the same root replays
+   ``jobs.jsonl``, re-queues the job (``resumed`` flagged), resumes
+   its survey journal and serves a ``peaks.csv`` byte-identical to the
+   control — the durability contract of docs/survey_service.md.
+
+Output directory: /tmp/riptide_serve_demo (or argv[1]). ``make
+serve-demo`` runs this; it is wired into ``make check-full``.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Every leg (and the rserve subprocess) compiles the same tiny search
+# plan; the persistent cache keeps all but the first to ~import cost.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/riptide_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.normpath(os.path.join(HERE, ".."))
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, HERE)
+
+TOBS, TSAMP, PERIOD = 12.0, 1e-3, 0.5
+DMS_A = (0.0, 5.0, 10.0)
+DMS_B = (2.0, 7.0, 12.0)
+
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+DEREDDEN = {"rmed_width": 4.0, "rmed_minpts": 101}
+
+
+def _req(base, path, method="GET", body=None, timeout=10.0):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        base + path, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+def _req_json(base, path, method="GET", body=None):
+    code, raw = _req(base, path, method=method, body=body)
+    return code, json.loads(raw)
+
+
+def _spec(files, tenant):
+    return {"files": list(files), "fmt": "presto", "tenant": tenant,
+            "deredden": dict(DEREDDEN), "search": SEARCH_CONF}
+
+
+def _wait_terminal(base, jid, timeout_s=300.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        code, doc = _req_json(base, f"/jobs/{jid}")
+        assert code == 200, doc
+        if doc.get("status") in ("done", "failed", "cancelled"):
+            return doc
+        time.sleep(0.1)
+    raise AssertionError(f"{jid} did not finish within {timeout_s}s")
+
+
+def _batch_control(files, jdir, csv_path):
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+    from riptide_tpu.serve.daemon import write_peaks_csv
+    from riptide_tpu.survey.journal import SurveyJournal
+    from riptide_tpu.survey.scheduler import SurveyScheduler
+
+    searcher = BatchSearcher(dict(DEREDDEN), SEARCH_CONF, fmt="presto",
+                             io_threads=1)
+    scheduler = SurveyScheduler(searcher, [[f] for f in files],
+                                journal=SurveyJournal(jdir))
+    peaks = scheduler.run()
+    write_peaks_csv(peaks, csv_path)
+    with open(csv_path, "rb") as fobj:
+        return fobj.read()
+
+
+def _rserve_env(faults=None):
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    for name in ("RIPTIDE_FAULT_INJECT", "RIPTIDE_PROM_PORT"):
+        env.pop(name, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if faults:
+        env["RIPTIDE_FAULT_INJECT"] = faults
+    return env
+
+
+def _start_rserve(root, faults=None, timeout_s=120.0):
+    """``(proc, base_url)`` of a tools/rserve.py subprocess, discovered
+    through the root's ``serve.port`` file (removed first so a restart
+    cannot read the PREVIOUS daemon's port)."""
+    port_file = os.path.join(root, "serve.port")
+    if os.path.exists(port_file):
+        os.remove(port_file)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "rserve.py"),
+         "--root", root, "--port", "0", "--workers", "1"],
+        env=_rserve_env(faults), cwd=ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(port_file):
+            port = int(open(port_file).read().strip())
+            return proc, f"http://127.0.0.1:{port}"
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise AssertionError(
+                f"rserve exited {proc.returncode} before binding:\n"
+                + "\n".join(out.splitlines()[-20:]))
+        time.sleep(0.05)
+    proc.kill()
+    raise AssertionError("rserve never published serve.port")
+
+
+def main(outdir="/tmp/riptide_serve_demo"):
+    from synth import generate_data_presto
+
+    import rreport
+    import rtop
+    from riptide_tpu.serve import ServeDaemon
+    from riptide_tpu.survey.metrics import get_metrics
+
+    shutil.rmtree(outdir, ignore_errors=True)
+    os.makedirs(outdir)
+    files_a = [
+        generate_data_presto(outdir, f"a_DM{dm:.1f}", tobs=TOBS,
+                             tsamp=TSAMP, period=PERIOD, dm=dm,
+                             amplitude=30.0)
+        for dm in DMS_A
+    ]
+    files_b = [
+        generate_data_presto(outdir, f"b_DM{dm:.1f}", tobs=TOBS,
+                             tsamp=TSAMP, period=PERIOD, dm=dm,
+                             amplitude=30.0)
+        for dm in DMS_B
+    ]
+
+    # -- leg 1: batch controls ----------------------------------------
+    control_a = _batch_control(files_a, os.path.join(outdir, "j_ctl_a"),
+                               os.path.join(outdir, "control_a.csv"))
+    control_b = _batch_control(files_b, os.path.join(outdir, "j_ctl_b"),
+                               os.path.join(outdir, "control_b.csv"))
+    print(f"controls OK: {len(control_a)} / {len(control_b)} bytes of "
+          "batch peaks.csv")
+
+    # -- leg 2: concurrent + warm service -----------------------------
+    serve1 = os.path.join(outdir, "serve1")
+    daemon = ServeDaemon(serve1, port=0, workers=2).start()
+    base = f"http://127.0.0.1:{daemon.port}"
+    try:
+        code, doc_a = _req_json(base, "/jobs", "POST",
+                                _spec(files_a, "alice"))
+        assert code == 202, doc_a
+        code, doc_b = _req_json(base, "/jobs", "POST",
+                                _spec(files_b, "bob"))
+        assert code == 202, doc_b
+        jid_a, jid_b = doc_a["job_id"], doc_b["job_id"]
+        done_a = _wait_terminal(base, jid_a)
+        done_b = _wait_terminal(base, jid_b)
+        assert done_a["status"] == "done", done_a.get("error")
+        assert done_b["status"] == "done", done_b.get("error")
+        assert _req(base, f"/jobs/{jid_a}/peaks")[1] == control_a, \
+            "service job A diverged from its batch control"
+        assert _req(base, f"/jobs/{jid_b}/peaks")[1] == control_b, \
+            "service job B diverged from its batch control"
+
+        # The warm second (here: third) job of the SAME plan geometry:
+        # zero cold builds, and the job document says so.
+        cold_before = get_metrics().counter("exec_cold_builds")
+        code, doc_c = _req_json(base, "/jobs", "POST",
+                                _spec(files_a, "alice"))
+        assert code == 202, doc_c
+        done_c = _wait_terminal(base, doc_c["job_id"])
+        assert done_c["status"] == "done", done_c.get("error")
+        cold_after = get_metrics().counter("exec_cold_builds")
+        assert cold_after == cold_before, \
+            f"warm repeat geometry recompiled: exec_cold_builds " \
+            f"{cold_before} -> {cold_after}"
+        assert done_c["warm_start"] is True, done_c
+        assert _req(base, f"/jobs/{doc_c['job_id']}/peaks")[1] \
+            == control_a, "warm service job diverged from control"
+        code, listing = _req_json(base, "/jobs")
+        pins = listing["geometry_pins"]
+        assert any(p["jobs"] >= 2 for p in pins.values()), pins
+        tenants = listing["tenants"]
+        assert tenants["alice"]["device_s_spent"] > 0
+        assert tenants["bob"]["device_s_spent"] > 0
+    finally:
+        daemon.stop()
+    print(f"service OK: 2 concurrent jobs byte-identical to controls; "
+          f"warm repeat job ran with exec_cold_builds flat "
+          f"({cold_after}) and warm_start={done_c['warm_start']}")
+
+    # The observability tools group the registry per job.
+    rep_mod = rreport.load_report_module()
+    frame = rtop.render_serve_frame(rep_mod, serve1)
+    assert jid_a in frame and "alice" in frame, frame
+    rc = rreport.main([serve1])
+    assert rc == 0, f"rreport on the serve dir exited {rc}"
+
+    # -- leg 3: kill mid-job, restart, byte-identical resume ----------
+    serve2 = os.path.join(outdir, "serve2")
+    proc, base = _start_rserve(serve2,
+                               faults="kill_at:journal_append:3")
+    code, doc = _req_json(base, "/jobs", "POST", _spec(files_a, "alice"))
+    assert code == 202, doc
+    jid = doc["job_id"]
+    proc.wait(timeout=300)
+    assert proc.returncode == 137, \
+        f"kill leg exited {proc.returncode}, wanted 137 (SIGKILL path)"
+    proc, base = _start_rserve(serve2)  # clean env: no fault this time
+    try:
+        doc = _wait_terminal(base, jid)
+        assert doc["status"] == "done", doc.get("error")
+        assert doc.get("resumed") is True, doc
+        code, payload = _req(base, f"/jobs/{jid}/peaks")
+        assert code == 200
+        assert payload == control_a, \
+            "restarted job's peaks.csv diverged from the batch control"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=60)
+    assert proc.returncode == 0, f"rserve shutdown exited {proc.returncode}"
+    print(f"recovery OK: daemon killed mid-job (exit 137), restart "
+          f"resumed {jid} to byte-identical peaks.csv")
+
+    print(f"\nserve demo OK: 4 service jobs across 2 daemons")
+    print(f"  serve dirs ->  {serve1}  {serve2}")
+    sys.stdout.write(frame)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(*sys.argv[1:2]))
