@@ -129,7 +129,8 @@ def get_parser():
     return parser
 
 
-def _http_json(url, method="GET", body=None, timeout=10.0):
+def _http_json(url, method="GET", body=None, timeout=10.0,
+               headers=None):
     """One loopback request to the service; returns (code, parsed doc or
     raw text). Stdlib-only — the submit client must work without jax."""
     import json as _json
@@ -137,9 +138,10 @@ def _http_json(url, method="GET", body=None, timeout=10.0):
     import urllib.request
 
     data = _json.dumps(body).encode() if body is not None else None
+    hdrs = {"Content-Type": "application/json"} if data else {}
+    hdrs.update(headers or {})
     req = urllib.request.Request(
-        url, data=data, method=method,
-        headers={"Content-Type": "application/json"} if data else {})
+        url, data=data, method=method, headers=hdrs)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             raw = resp.read()
@@ -180,7 +182,27 @@ def run_submit(args, poll_s=0.25, timeout_s=600.0):
     }
     if args.fault_inject:
         spec["fault_inject"] = args.fault_inject
-    code, doc = _http_json(base + "/jobs", method="POST", body=spec)
+    # One Idempotency-Key for the WHOLE retry loop: if a submit times
+    # out after the daemon accepted it, the retry replays the existing
+    # job instead of double-enqueueing the survey.
+    import urllib.error
+    import uuid
+    idem_key = uuid.uuid4().hex
+    last_err = None
+    for attempt in range(3):
+        try:
+            code, doc = _http_json(
+                base + "/jobs", method="POST", body=spec,
+                headers={"Idempotency-Key": idem_key})
+        except (urllib.error.URLError, OSError, TimeoutError) as err:
+            last_err = err
+            log.warning("submit attempt %d failed (%s); retrying with "
+                        "the same Idempotency-Key", attempt + 1, err)
+            _time.sleep(0.5 * (attempt + 1))
+            continue
+        break
+    else:
+        raise RuntimeError(f"submit failed after retries: {last_err}")
     if code != 202:
         raise RuntimeError(f"submit rejected ({code}): {doc}")
     jid = doc["job_id"]

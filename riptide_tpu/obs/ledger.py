@@ -60,7 +60,7 @@ FINGERPRINT_EXCLUDE = frozenset({
     # service contract promises.
     "RIPTIDE_SERVE", "RIPTIDE_SERVE_MAX_JOBS",
     "RIPTIDE_SERVE_QUOTA_DEVICE_S", "RIPTIDE_SERVE_PORT",
-    "RIPTIDE_SERVE_DIR",
+    "RIPTIDE_SERVE_DIR", "RIPTIDE_SERVE_DRAIN_TIMEOUT_S",
 })
 
 

@@ -52,7 +52,7 @@ __all__ = ["render", "write_prom", "serve", "maybe_serve",
 
 # Every path the daemon answers; the 404 body enumerates them so a
 # mistyped scrape target is self-diagnosing.
-ENDPOINTS = ("/", "/metrics", "/status", "/healthz", "/jobs")
+ENDPOINTS = ("/", "/metrics", "/status", "/healthz", "/jobs", "/drain")
 
 PROM_PREFIX = "riptide"
 
@@ -286,13 +286,20 @@ def set_status_provider(provider):
 def status_snapshot():
     """The current ``/status`` document: the provider's dict plus
     ``"active": True``, or ``{"active": False}`` when no survey has
-    registered one (the daemon may outlive — or predate — a run)."""
+    registered one (the daemon may outlive — or predate — a run).
+    With a draining survey service registered, ``"draining": True`` is
+    merged in so a load balancer/supervisor sees the drain from the
+    same page it scrapes."""
     with _status_lock:
         provider = _status_provider
     if provider is None:
-        return {"active": False}
-    status = dict(provider())
-    status.setdefault("active", True)
+        status = {"active": False}
+    else:
+        status = dict(provider())
+        status.setdefault("active", True)
+    api = _current_jobs_api()
+    if api is not None and getattr(api, "draining", False):
+        status["draining"] = True
     return status
 
 
@@ -339,10 +346,12 @@ _jobs_lock = threading.Lock()
 
 def set_jobs_api(api):
     """Install the survey service's job API (None uninstalls); returns
-    the previous one. The api object answers ``submit(payload)``,
-    ``list()``, ``get(job_id)``, ``cancel(job_id)`` and
-    ``peaks_csv(job_id)`` — all but ``list`` returning
-    ``(http_code, document)`` (see riptide_tpu.serve.daemon)."""
+    the previous one. The api object answers
+    ``submit(payload, idempotency_key=None)``, ``list()``,
+    ``get(job_id)``, ``cancel(job_id)`` and ``peaks_csv(job_id)`` —
+    all but ``list`` returning ``(http_code, document)`` — plus
+    ``drain()`` (the POST /drain admin verb) and a ``draining``
+    property merged into /status (see riptide_tpu.serve.daemon)."""
     global _jobs_api
     with _jobs_lock:
         prev, _jobs_api = _jobs_api, api
@@ -363,11 +372,13 @@ class _PromServer:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         class Handler(BaseHTTPRequestHandler):
-            def _reply(self, code, body, ctype):
+            def _reply(self, code, body, ctype, headers=None):
                 data = body.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -406,10 +417,14 @@ class _PromServer:
 
             def do_POST(self):  # noqa: N802 (http.server API)
                 path = self.path.split("?")[0]
+                if path == "/drain":
+                    self._drain()
+                    return
                 if path != "/jobs":
                     self._reply(404, json.dumps(
                         {"error": f"POST {path!r} unsupported; "
-                                  "submit to /jobs"}),
+                                  "submit to /jobs or drain via "
+                                  "/drain"}),
                         "application/json")
                     return
                 length = int(self.headers.get("Content-Length") or 0)
@@ -421,7 +436,29 @@ class _PromServer:
                         {"error": f"bad JSON body: {err}"}),
                         "application/json")
                     return
-                self._jobs(path, "POST", body)
+                self._jobs(path, "POST", body,
+                           idempotency_key=self.headers.get(
+                               "Idempotency-Key"))
+
+            def _drain(self):
+                """POST /drain: the admin verb of the survey service's
+                graceful drain (same path the SIGTERM handler takes).
+                202 + ``{"draining": true}`` once initiated; idempotent."""
+                api = _current_jobs_api()
+                if api is None or not hasattr(api, "drain"):
+                    self._reply(503, json.dumps(
+                        {"error": "no survey service running here "
+                                  "(start one with tools/rserve.py)"}),
+                        "application/json")
+                    return
+                try:
+                    api.drain()
+                except Exception as err:
+                    self._reply(500, json.dumps({"error": str(err)}),
+                                "application/json")
+                    return
+                self._reply(202, json.dumps({"draining": True}),
+                            "application/json")
 
             def do_DELETE(self):  # noqa: N802 (http.server API)
                 path = self.path.split("?")[0]
@@ -433,7 +470,8 @@ class _PromServer:
                     return
                 self._jobs(path, "DELETE")
 
-            def _jobs(self, path, method, body=None):
+            def _jobs(self, path, method, body=None,
+                      idempotency_key=None):
                 """One /jobs request against the installed jobs API
                 (503 when no service daemon has registered one)."""
                 api = _current_jobs_api()
@@ -445,7 +483,8 @@ class _PromServer:
                     return
                 try:
                     if method == "POST":
-                        code, doc = api.submit(body or {})
+                        code, doc = api.submit(
+                            body or {}, idempotency_key=idempotency_key)
                     elif method == "GET" and path == "/jobs":
                         code, doc = 200, api.list()
                     elif method == "GET" and path.endswith("/peaks"):
@@ -472,7 +511,13 @@ class _PromServer:
                     log.warning("jobs api failed for %s %s: %s",
                                 method, path, err)
                     code, doc = 500, {"error": str(err)}
-                self._reply(code, json.dumps(doc), "application/json")
+                headers = None
+                if isinstance(doc, dict) and doc.get("retry_after_s"):
+                    # Back-pressure responses (429 admission-full, 503
+                    # draining) advise the client when to retry.
+                    headers = {"Retry-After": str(doc["retry_after_s"])}
+                self._reply(code, json.dumps(doc), "application/json",
+                            headers=headers)
 
             def log_message(self, fmt, *args):
                 log.debug("prom endpoint: " + fmt, *args)

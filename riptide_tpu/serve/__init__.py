@@ -8,8 +8,10 @@ endpoint (``POST /jobs``) or with ``rseek --submit``. See
 ``docs/survey_service.md``.
 """
 from .daemon import GeometryPins, JobRegistry, ServeDaemon
-from .queue import FairShareQueue, JobCancelled, QuotaExceeded
+from .queue import (FairShareQueue, JobCancelled, JobDeadlineExceeded,
+                    JobDrained, QuotaExceeded)
 from .tenants import TenantTable
 
 __all__ = ["ServeDaemon", "JobRegistry", "GeometryPins", "FairShareQueue",
-           "TenantTable", "JobCancelled", "QuotaExceeded"]
+           "TenantTable", "JobCancelled", "JobDeadlineExceeded",
+           "JobDrained", "QuotaExceeded"]

@@ -37,12 +37,19 @@ scheduler.SurveyScheduler` with its own journal/peaks store under
 fleet sidecars and evaluates alert rules — ``rreport --compare``,
 ``rwatch`` and ``rtop`` work unchanged on a service job's directory.
 
-Known limitation (documented contract): the incident sink, status
-provider and storage-fault hook are process-global, installed by each
-scheduler run — with several jobs in flight the LAST started job owns
-them, so a concurrent job's down-stack incidents may journal into a
-sibling. Chunk records, peaks, ledger rows and fleet sidecars are
-always per-job.
+Incident/fault attribution is job-scoped (PR 17): each job's worker
+thread owns a :class:`~riptide_tpu.utils.runctx.RunContext` carrying
+the job's incident sink and storage-fault plan (inherited by every
+thread its scheduler starts), so with several jobs in flight every
+incident record — including daemon-level ones like ``job_cancelled``
+or ``job_timeout`` — lands in its own job's journal. The process-global
+hooks remain the fallback layer for batch runs.
+
+Service survival (PR 17): a SIGTERM/SIGINT to ``tools/rserve.py`` (or
+``POST /drain``) triggers a **graceful drain** — admission stops (503
++ ``draining`` in ``/status``), the running chunk finishes, every
+running job parks through the chunk gate WITHOUT a terminal record,
+and the process exits 0 with a registry a restart resumes exactly.
 """
 import datetime
 import glob
@@ -54,9 +61,10 @@ import time
 
 from ..obs import prom
 from ..survey import incidents
-from ..survey.journal import _utc_iso
-from ..utils import envflags, fsio
-from .queue import FairShareQueue, JobCancelled, QuotaExceeded
+from ..survey.journal import SurveyJournal, _utc_iso
+from ..utils import envflags, fsio, runctx
+from .queue import (FairShareQueue, JobCancelled, JobDeadlineExceeded,
+                    JobDrained, QuotaExceeded)
 from .tenants import TenantTable
 
 log = logging.getLogger("riptide_tpu.serve.daemon")
@@ -77,10 +85,16 @@ _STATUS = {"submitted": "pending", "started": "running", "done": "done",
 # (the same running-median config the chaos campaign and demos use).
 DEFAULT_DEREDDEN = {"rmed_width": 4.0, "rmed_minpts": 101}
 
+# Retry-After hints (seconds) on refused admissions. A 429 clears as
+# soon as a resident job finishes; a 503 drain clears only once a
+# supervisor restarts the daemon.
+ADMISSION_RETRY_AFTER_S = 2
+DRAIN_RETRY_AFTER_S = 30
+
 
 def job_record(job_id, event, tenant=None, priority=None, spec=None,
                error=None, npeaks=None, device_s=None, queue_wait_s=None,
-               chunks_total=None, resumed=None):
+               chunks_total=None, resumed=None, idempotency_key=None):
     """The ONE builder of ``jobs.jsonl`` records — every key a reader
     (obs/report.py's job table, rtop's serve view) can see is a literal
     here (the RIP010 writer spec for the ``job`` family)::
@@ -88,8 +102,10 @@ def job_record(job_id, event, tenant=None, priority=None, spec=None,
         {"kind": "job", "job_id": "j0001", "event": "submitted",
          "utc": "...Z", "tenant": "...", "priority": 0, "spec": {...}}
 
-    Terminal events add ``npeaks`` / ``device_s`` / ``queue_wait_s`` /
-    ``chunks_total`` (done) or ``error`` (failed)."""
+    ``submitted`` events may carry the client's ``idempotency_key``
+    (replayed into the dedupe map on restart). Terminal events add
+    ``npeaks`` / ``device_s`` / ``queue_wait_s`` / ``chunks_total``
+    (done) or ``error`` (failed)."""
     rec = {"kind": "job", "job_id": str(job_id), "event": str(event),
            "utc": _utc_iso()}
     if tenant is not None:
@@ -98,6 +114,8 @@ def job_record(job_id, event, tenant=None, priority=None, spec=None,
         rec["priority"] = int(priority)
     if spec is not None:
         rec["spec"] = spec
+    if idempotency_key is not None:
+        rec["idempotency_key"] = str(idempotency_key)
     if error is not None:
         rec["error"] = str(error)
     if npeaks is not None:
@@ -132,6 +150,8 @@ def fold_job_events(records):
             st["priority"] = int(rec.get("priority") or 0)
             st["spec"] = rec.get("spec") or {}
             st["submitted_utc"] = rec.get("utc")
+            if rec.get("idempotency_key"):
+                st["idempotency_key"] = rec["idempotency_key"]
         elif event == "started":
             st["started_utc"] = rec.get("utc")
             st["resumed"] = bool(rec.get("resumed"))
@@ -313,6 +333,12 @@ class ServeDaemon:
         self._stop = False
         self._threads = []
         self._server = None
+        # Idempotency-Key -> job_id dedupe map (rebuilt from the
+        # registry on start, TERMINAL jobs included: a retried POST
+        # after completion still returns the original job).
+        self._idem = {}
+        self._draining = False
+        self._drained = threading.Event()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -322,6 +348,9 @@ class ServeDaemon:
         the /jobs API and start the workers. Returns self."""
         os.makedirs(os.path.join(self.root, "jobs"), exist_ok=True)
         self._jobs, self._seq = self.registry.replay()
+        self._idem = {st["idempotency_key"]: jid
+                      for jid, st in self._jobs.items()
+                      if st.get("idempotency_key")}
         resumed = [jid for jid in sorted(self._jobs)
                    if self._jobs[jid].get("status") in
                    ("pending", "running")]
@@ -374,15 +403,82 @@ class ServeDaemon:
             self._server.close()
             self._server = None
 
+    def drain(self, timeout=None):
+        """Initiate a graceful drain (SIGTERM/SIGINT in rserve, or
+        ``POST /drain``): stop admission (submit answers 503 with
+        ``draining``), stop workers picking pending jobs, and flag the
+        fair-share queue so every RUNNING job finishes its in-flight
+        chunk and parks at the gate WITHOUT a terminal registry record
+        — a restart replays ``jobs.jsonl`` and resumes each parked
+        job's journal exactly. Idempotent; returns immediately (a
+        background thread joins the workers and sets the drained
+        event — :meth:`wait_drained`). ``timeout`` bounds that join
+        (default ``RIPTIDE_SERVE_DRAIN_TIMEOUT_S``)."""
+        with self._cond:
+            if self._draining:
+                return
+            self._draining = True
+            self._stop = True
+            self._cond.notify_all()
+        log.info("serve: draining — admission stopped, running chunks "
+                 "finishing")
+        self.queue.drain()
+        timeout = (float(envflags.get("RIPTIDE_SERVE_DRAIN_TIMEOUT_S"))
+                   if timeout is None else float(timeout))
+        threading.Thread(target=self._finish_drain, args=(timeout,),
+                         name="riptide-serve-drain", daemon=True).start()
+
+    def _finish_drain(self, timeout):
+        deadline = time.monotonic() + max(0.1, timeout)
+        for t in self._threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        stuck = [t.name for t in self._threads if t.is_alive()]
+        if stuck:
+            log.warning("serve: drain timed out waiting for %s",
+                        ", ".join(stuck))
+        else:
+            log.info("serve: drained — all workers parked, registry "
+                     "flushed")
+        self._drained.set()
+
+    def wait_drained(self, timeout=None):
+        """Block until a drain started by :meth:`drain` has parked all
+        workers (True) or ``timeout`` elapsed (False)."""
+        return self._drained.wait(timeout)
+
+    @property
+    def draining(self):
+        with self._lock:
+            return self._draining
+
     # -- the jobs API (called from HTTP handler threads) -----------------
 
-    def submit(self, payload):
+    def submit(self, payload, idempotency_key=None):
         """``(code, doc)`` for POST /jobs. 202 on acceptance; 400 on a
         bad spec; 429 on admission refusal (resident cap or tenant
-        quota), with a ``job_rejected`` incident either way."""
+        quota), with a ``job_rejected`` incident and a
+        ``retry_after_s`` hint; 503 while draining. A repeated
+        ``idempotency_key`` returns the EXISTING job's document (202)
+        instead of double-enqueueing — the client retry contract after
+        a timed-out response."""
         spec = dict(payload or {})
         tenant = str(spec.get("tenant") or "default")
         priority = int(spec.get("priority") or 0)
+        with self._lock:
+            if self._draining:
+                return 503, {"error": "service draining; resubmit after "
+                                      "the daemon restarts",
+                             "draining": True,
+                             "retry_after_s": DRAIN_RETRY_AFTER_S}
+            if idempotency_key is not None \
+                    and str(idempotency_key) in self._idem:
+                jid = self._idem[str(idempotency_key)]
+            else:
+                jid = None
+        if jid is not None:
+            log.info("serve: idempotent replay of %s (key %s)",
+                     jid, idempotency_key)
+            return 202, self._job_doc(jid)
         try:
             files = resolve_files(spec)
         except (ValueError, TypeError, OSError) as err:
@@ -390,6 +486,13 @@ class ServeDaemon:
         if not isinstance(spec.get("search"), list) or not spec["search"]:
             return 400, {"error": "job spec needs 'search': a non-empty "
                                   "list of range configs"}
+        if spec.get("deadline_s") is not None:
+            try:
+                if float(spec["deadline_s"]) <= 0:
+                    raise ValueError
+            except (TypeError, ValueError):
+                return 400, {"error": "'deadline_s' must be a positive "
+                                      "number of seconds"}
         with self._lock:
             resident = sum(1 for st in self._jobs.values()
                            if st.get("status") in ("pending", "running"))
@@ -397,21 +500,39 @@ class ServeDaemon:
             incidents.emit("job_rejected", tenant=tenant,
                            reason=f"resident job cap {self.max_jobs}")
             return 429, {"error": f"service at max resident jobs "
-                                  f"({self.max_jobs})"}
+                                  f"({self.max_jobs})",
+                         "retry_after_s": ADMISSION_RETRY_AFTER_S}
         ok, reason = self.tenants.admit(tenant)
         if not ok:
             incidents.emit("job_rejected", tenant=tenant, reason=reason)
-            return 429, {"error": reason}
+            return 429, {"error": reason,
+                         "retry_after_s": ADMISSION_RETRY_AFTER_S}
+        with self._cond:
+            # Re-check under the lock: two concurrent POSTs sharing a
+            # key must still enqueue exactly one job.
+            if idempotency_key is not None \
+                    and str(idempotency_key) in self._idem:
+                jid = self._idem[str(idempotency_key)]
+                replay = True
+            else:
+                replay = False
+        if replay:
+            log.info("serve: idempotent replay of %s (key %s)",
+                     jid, idempotency_key)
+            return 202, self._job_doc(jid)
         with self._cond:
             jid = f"j{self._seq:04d}"
             self._seq += 1
             rec = job_record(jid, "submitted", tenant=tenant,
-                             priority=priority, spec=spec)
+                             priority=priority, spec=spec,
+                             idempotency_key=idempotency_key)
             self.registry.append(rec)
             self._jobs[jid] = fold_job_events([rec])[jid]
             self._jobs[jid]["nfiles"] = len(files)
             self._pending.append(jid)
             self.tenants.job_started(tenant)
+            if idempotency_key is not None:
+                self._idem[str(idempotency_key)] = jid
             self._cond.notify_all()
         log.info("serve: accepted %s (tenant %s, %d file(s))",
                  jid, tenant, len(files))
@@ -528,6 +649,20 @@ class ServeDaemon:
             resumed = bool(st.get("resumed"))
         jobdir = self.job_dir(jid)
         os.makedirs(jobdir, exist_ok=True)
+        # The job-scoped run context: installed for the whole worker
+        # body so DAEMON-level incidents (job_cancelled, quota,
+        # job_timeout, device_error attribution) journal into THIS
+        # job's journal — _execute's scheduler then nests its own
+        # context (same journal, plus status/fault plan) inside it.
+        ctx = runctx.RunContext(
+            incident_sink=SurveyJournal(jobdir).record_incident,
+            label=jid)
+        with runctx.activate(ctx):
+            self._run_job_in_ctx(jid, st, spec, tenant, priority,
+                                 resumed, jobdir)
+
+    def _run_job_in_ctx(self, jid, st, spec, tenant, priority, resumed,
+                        jobdir):
         started = job_record(jid, "started", resumed=resumed)
         self.registry.append(started)
         with self._lock:
@@ -536,7 +671,9 @@ class ServeDaemon:
         warm = self.pins.warm_start(geometry_key(spec))
         with self._lock:
             st["warm_start"] = warm
-        gate = self.queue.register(jid, tenant=tenant, priority=priority)
+        deadline_s = spec.get("deadline_s")
+        gate = self.queue.register(jid, tenant=tenant, priority=priority,
+                                   deadline_s=deadline_s)
         with self._lock:
             if st.get("cancel_requested"):
                 self.queue.cancel(jid)
@@ -559,6 +696,15 @@ class ServeDaemon:
                           queue_wait_s=done.get("queue_wait_s"),
                           chunks_total=nchunks)
             log.info("serve: %s done (%d peak(s))", jid, len(peaks))
+        except JobDrained:
+            # Graceful drain: NO terminal record — the job stays
+            # `running` in the registry, so the restart's replay
+            # re-queues it (`resumed`) and its journal picks up at the
+            # chunk after the one that finished. In-memory status is
+            # left running too: /status and /jobs keep telling the
+            # truth while the daemon finishes draining.
+            log.info("serve: %s parked at chunk boundary for drain "
+                     "(resumable on restart)", jid)
         except JobCancelled:
             incidents.emit("job_cancelled", job_id=jid, tenant=tenant,
                            while_status="running")
@@ -567,6 +713,17 @@ class ServeDaemon:
             with self._lock:
                 st.update(status="cancelled", finished_utc=rec["utc"])
             log.info("serve: %s cancelled at chunk boundary", jid)
+        except JobDeadlineExceeded as err:
+            incidents.emit("job_timeout", job_id=jid, tenant=tenant,
+                           deadline_s=spec.get("deadline_s"),
+                           detail_msg=str(err))
+            rec = job_record(jid, "failed", error=str(err))
+            self.registry.append(rec)
+            with self._lock:
+                st.update(status="failed", finished_utc=rec["utc"],
+                          error=str(err))
+            log.info("serve: %s stopped at its deadline (journal "
+                     "resumable)", jid)
         except QuotaExceeded as err:
             incidents.emit("quota_exceeded", job_id=jid, tenant=tenant,
                            detail_msg=str(err))
@@ -576,7 +733,17 @@ class ServeDaemon:
                 st.update(status="failed", finished_utc=rec["utc"],
                           error=str(err))
         except Exception as err:
-            log.exception("serve: %s failed", jid)
+            from ..survey.liveness import is_device_error
+
+            if is_device_error(err):
+                # Classified, contained failure: the scheduler already
+                # journaled the device_error incident and evicted the
+                # resident executables on each retry — an expected
+                # terminal outcome logs clean, no traceback.
+                log.error("serve: %s failed with a persistent device "
+                          "error: %s", jid, err)
+            else:
+                log.exception("serve: %s failed", jid)
             rec = job_record(jid, "failed", error=str(err))
             self.registry.append(rec)
             with self._lock:
@@ -598,7 +765,11 @@ class ServeDaemon:
     def _execute(self, jid, spec, jobdir, gate):
         """Run one job through the ordinary survey machinery (imported
         lazily — the daemon module itself stays importable without
-        jax). Returns ``(peaks, nchunks)``."""
+        jax). Runs inside the job's RunContext (installed by
+        :meth:`_run_job`); ``scheduler.run()`` nests its own context —
+        same journal sink, plus this job's status provider and fault
+        plan — so every scheduler-started thread attributes to this
+        job. Returns ``(peaks, nchunks)``."""
         from ..pipeline.batcher import BatchSearcher
         from ..survey.faults import FaultPlan
         from ..survey.journal import SurveyJournal

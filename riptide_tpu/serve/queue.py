@@ -14,12 +14,13 @@ time, then to submission order), so a tenant running five jobs cannot
 starve a tenant running one — classic weighted-fair-queueing vruntime,
 charged from the gate's own begin→end wall clock.
 
-The gate is also the service's ONLY interruption point: cancellation
-and quota enforcement raise :class:`JobCancelled` /
-:class:`QuotaExceeded` out of ``begin()``, i.e. between chunks, after
-the previous chunk's journal record was fsync'd — so an interrupted
-job's journal is always resumable (the durability contract of
-docs/survey_service.md).
+The gate is also the service's ONLY interruption point: cancellation,
+quota enforcement, per-job deadlines and a graceful drain raise
+:class:`JobCancelled` / :class:`QuotaExceeded` /
+:class:`JobDeadlineExceeded` / :class:`JobDrained` out of ``begin()``,
+i.e. between chunks, after the previous chunk's journal record was
+fsync'd — so an interrupted job's journal is always resumable (the
+durability contract of docs/survey_service.md).
 
 Stdlib-only; the daemon (:mod:`riptide_tpu.serve.daemon`) owns the
 lifecycle around it.
@@ -27,7 +28,8 @@ lifecycle around it.
 import threading
 import time
 
-__all__ = ["FairShareQueue", "JobCancelled", "QuotaExceeded"]
+__all__ = ["FairShareQueue", "JobCancelled", "JobDeadlineExceeded",
+           "JobDrained", "QuotaExceeded"]
 
 
 class JobCancelled(Exception):
@@ -40,11 +42,24 @@ class QuotaExceeded(Exception):
     device-seconds budget is exhausted."""
 
 
+class JobDeadlineExceeded(Exception):
+    """Raised out of a job's chunk gate when its ``deadline_s`` wall
+    clock (measured from registration) has expired. Like a quota stop,
+    the journal is left resumable — a resubmit with a fresh deadline
+    continues from the completed chunks."""
+
+
+class JobDrained(Exception):
+    """Raised out of a job's chunk gate when the daemon is draining:
+    the running chunk finished, this job parks WITHOUT a terminal
+    registry record, and a restart re-queues it (``resumed``)."""
+
+
 class _Entry:
     __slots__ = ("job_id", "tenant", "priority", "seq", "device_s",
-                 "waiting", "cancelled", "t0")
+                 "waiting", "cancelled", "t0", "deadline")
 
-    def __init__(self, job_id, tenant, priority, seq):
+    def __init__(self, job_id, tenant, priority, seq, deadline_s=None):
         self.job_id = job_id
         self.tenant = tenant
         self.priority = int(priority)
@@ -53,6 +68,10 @@ class _Entry:
         self.waiting = False     # parked in begin(), wanting a turn
         self.cancelled = False
         self.t0 = None           # perf_counter at turn grant
+        # Wall-clock cutoff (monotonic) from registration; None = no
+        # per-job deadline.
+        self.deadline = (None if deadline_s is None
+                         else time.monotonic() + float(deadline_s))
 
 
 class _Gate:
@@ -86,18 +105,23 @@ class FairShareQueue:
         self._tenant_device_s = {}
         self._active = None     # job_id holding the device turn
         self._seq = 0
+        self._draining = False
         self.tenants = tenants
 
     # -- registration ----------------------------------------------------
 
-    def register(self, job_id, tenant="default", priority=0):
+    def register(self, job_id, tenant="default", priority=0,
+                 deadline_s=None):
         """Add a job and return its :class:`_Gate` (the scheduler's
         ``chunk_gate``). Re-registering an id replaces the old entry
         (a restarted job keeps its tenant's accumulated fair share —
-        that lives in the per-tenant total, not the entry)."""
+        that lives in the per-tenant total, not the entry).
+        ``deadline_s`` arms a per-job wall-clock cutoff enforced at the
+        gate like quotas."""
         with self._cond:
             self._entries[job_id] = _Entry(
-                job_id, tenant, priority, self._seq)
+                job_id, tenant, priority, self._seq,
+                deadline_s=deadline_s)
             self._seq += 1
             self._tenant_device_s.setdefault(tenant, 0.0)
         return _Gate(self, job_id)
@@ -120,6 +144,21 @@ class FairShareQueue:
             self._cond.notify_all()
             return True
 
+    def drain(self):
+        """Flag the whole queue draining: every gate raises
+        :class:`JobDrained` at its next ``begin()`` (a chunk already
+        holding the turn finishes and charges normally through
+        ``end()``), so every running job parks at a chunk boundary
+        with its journal resumable."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    @property
+    def draining(self):
+        with self._cond:
+            return self._draining
+
     # -- the turn protocol ----------------------------------------------
 
     def _pick(self):
@@ -134,6 +173,14 @@ class FairShareQueue:
             e.seq,
         ))
 
+    @staticmethod
+    def _check_deadline(entry):
+        if entry.deadline is not None \
+                and time.monotonic() >= entry.deadline:
+            raise JobDeadlineExceeded(
+                f"{entry.job_id}: deadline_s exceeded at the chunk "
+                "boundary")
+
     def begin(self, job_id, chunk_id):
         with self._cond:
             entry = self._entries.get(job_id)
@@ -141,6 +188,9 @@ class FairShareQueue:
                 raise JobCancelled(f"{job_id}: not registered")
             if entry.cancelled:
                 raise JobCancelled(f"{job_id}: cancelled")
+            if self._draining:
+                raise JobDrained(f"{job_id}: daemon draining")
+            self._check_deadline(entry)
             if self.tenants is not None \
                     and self.tenants.exhausted(entry.tenant):
                 raise QuotaExceeded(
@@ -153,6 +203,9 @@ class FairShareQueue:
                     self._cond.wait(timeout=0.5)
                     if entry.cancelled:
                         raise JobCancelled(f"{job_id}: cancelled")
+                    if self._draining:
+                        raise JobDrained(f"{job_id}: daemon draining")
+                    self._check_deadline(entry)
             finally:
                 entry.waiting = False
             self._active = job_id
@@ -186,6 +239,7 @@ class FairShareQueue:
         with self._cond:
             return {
                 "active": self._active,
+                "draining": self._draining,
                 "jobs": {
                     e.job_id: {
                         "tenant": e.tenant,

@@ -137,6 +137,23 @@ def builtin_schedules():
          "legs": [{"faults": "kill_at:journal_append:3", "expect": "kill"},
                   {"faults": "", "resume": True}],
          "incidents": ["storage_recovered"]},
+        # Graceful drain (PR 17): leg 0 drains the daemon mid-job (after
+        # exactly one chunk — a low-priority blocker steps the queue
+        # deterministically) and must exit 0 with the job parked
+        # non-terminally; the restart leg re-queues it and serves a
+        # byte-identical peaks.csv.
+        {"name": "serve-drain-mid-job", "serve": True,
+         "legs": [{"faults": "", "serve_drain": True},
+                  {"faults": "", "resume": True}],
+         "incidents": []},
+        # Device-error recovery (PR 17): two jobs share the daemon; the
+        # second carries a spec-level device_error fault that outlasts
+        # the retry budget and must fail ALONE (a `device_error`
+        # incident in its own journal) while the clean sibling (j0001,
+        # the directory the campaign checks) completes normally.
+        {"name": "serve-device-error", "serve": True,
+         "legs": [{"faults": "", "serve_device_error": True}],
+         "incidents": []},
     ]
 
 
@@ -210,6 +227,8 @@ def _run_leg(schedule, i, leg, paths, python, timeout_s):
         "cache_reload": bool(leg.get("cache_reload", False)),
         "serve": bool(schedule.get("serve", False)),
         "serve_root": paths.get("serve_root"),
+        "serve_drain": bool(leg.get("serve_drain", False)),
+        "serve_device_error": bool(leg.get("serve_device_error", False)),
     }
     cfg_path = os.path.join(paths["sdir"], f"leg{i}.json")
     with open(cfg_path, "w") as fobj:
@@ -505,6 +524,176 @@ def _serve_leg_main(cfg):
     return 0
 
 
+def _serve_job_spec(cfg, **extra):
+    """The standard serve-leg job spec (same survey as the batch legs)."""
+    return dict({"files": cfg["files"], "fmt": "presto",
+                 "deredden": {"rmed_width": 4.0, "rmed_minpts": 101},
+                 "search": SEARCH_CONF}, **extra)
+
+
+def _serve_post_job(base, spec):
+    import urllib.request
+
+    req = urllib.request.Request(
+        base + "/jobs", data=json.dumps(spec).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10.0) as resp:
+        return json.loads(resp.read())["job_id"]
+
+
+def _journal_incidents(serve_root, jid):
+    """Incident kinds journaled in one job's own journal.jsonl."""
+    path = os.path.join(serve_root, "jobs", jid, "journal.jsonl")
+    if not os.path.exists(path):
+        return set()
+    entries, _ = fsio.scan_jsonl(path)
+    return {obj.get("incident") for obj, _status, _off in entries
+            if obj and obj.get("kind") == "incident"}
+
+
+def _serve_drain_leg_main(cfg):
+    """Drain leg of ``serve-drain-mid-job``: submit the survey as a
+    job, let it finish EXACTLY one chunk (a priority ``-1`` blocker
+    gate steps the fair-share queue deterministically), then
+    :meth:`ServeDaemon.drain` mid-job. Admission must answer 503 with a
+    ``Retry-After`` hint, the workers must park within the drain
+    budget, and the job must end the leg WITHOUT a terminal registry
+    record — the restart leg re-queues it (``resumed``) and must serve
+    a peaks.csv byte-identical to the control run's."""
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    from ..serve import JobDrained, ServeDaemon
+
+    daemon = ServeDaemon(cfg["serve_root"], port=0, workers=1).start()
+    base = f"http://127.0.0.1:{daemon.port}"
+    # The blocker holds the device turn so the job parks at begin(0)
+    # while the leg lines up the stepping.
+    blocker = daemon.queue.register("blocker", priority=-1)
+    blocker.begin(0)
+    jid = _serve_post_job(base, _serve_job_spec(cfg))
+
+    def _wait(pred, what, timeout=180.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        raise ChaosFailure(f"drain leg: timed out waiting for {what}")
+
+    def _parked():
+        return bool(daemon.queue.snapshot()["jobs"]
+                    .get(jid, {}).get("waiting"))
+
+    jpath = os.path.join(cfg["serve_root"], "jobs", jid, "journal.jsonl")
+
+    def _chunks_done():
+        if not os.path.exists(jpath):
+            return 0
+        entries, _ = fsio.scan_jsonl(jpath)
+        return sum(1 for obj, _status, _off in entries
+                   if obj and obj.get("kind") == "chunk")
+
+    _wait(_parked, f"{jid} to park at its chunk gate")
+
+    def _reblock():
+        # Re-queue for the turn AFTER chunk 0's: at priority -1 the
+        # blocker wins the next pick, so the job parks again at
+        # begin(1) instead of running to completion. The drain below
+        # unparks US too — swallow it.
+        try:
+            blocker.begin(1)
+        except JobDrained:
+            pass
+
+    blocker.end(0)  # job takes the turn: chunk 0 dispatches
+    threading.Thread(target=_reblock, daemon=True,
+                     name="chaos-drain-blocker").start()
+    _wait(lambda: _chunks_done() >= 1, "chunk 0's journal record")
+    _wait(_parked, f"{jid} to park mid-job")
+    done = _chunks_done()
+    if not 1 <= done < len(cfg["files"]):
+        raise ChaosFailure(f"drain leg: {done} chunk(s) journaled "
+                           "before the drain; wanted a mid-job park")
+
+    daemon.drain()
+    # Admission is closed the moment drain() returns.
+    try:
+        _serve_post_job(base, _serve_job_spec(cfg))
+        raise ChaosFailure("drain leg: admission still open after drain")
+    except urllib.error.HTTPError as err:
+        if err.code != 503 or not err.headers.get("Retry-After"):
+            raise ChaosFailure(
+                f"drain leg: submit during drain answered {err.code} "
+                f"(Retry-After {err.headers.get('Retry-After')!r}); "
+                "expected 503 with a Retry-After hint")
+    if not daemon.wait_drained(timeout=60.0):
+        raise ChaosFailure("drain leg: workers did not park within the "
+                           "drain budget")
+    docs = {d["job_id"]: d for d in daemon.list()["jobs"]}
+    status = docs.get(jid, {}).get("status")
+    if status not in ("pending", "running"):
+        raise ChaosFailure(
+            f"drain leg: job {jid} ended the leg with terminal status "
+            f"{status!r}; a drained job must stay resumable")
+    daemon.stop()
+    return 0
+
+
+def _serve_device_error_leg_main(cfg):
+    """``serve-device-error``: two jobs share the warm daemon; the
+    second carries a ``device_error:0x9`` spec fault — more firings
+    than the per-job retry budget, so its chunk 0 exhausts the retry
+    path (evicting resident executables each attempt) and the job
+    fails. The failure must be CONTAINED: a ``device_error`` incident
+    in the faulted job's own journal only, the clean sibling (j0001,
+    the directory the campaign's invariants check) done, and the
+    daemon still serving its peaks afterwards."""
+    import time
+    import urllib.request
+
+    from ..serve import ServeDaemon
+
+    daemon = ServeDaemon(cfg["serve_root"], port=0, workers=2).start()
+    base = f"http://127.0.0.1:{daemon.port}"
+    clean = _serve_post_job(base, _serve_job_spec(cfg))
+    faulted = _serve_post_job(
+        base, _serve_job_spec(cfg, fault_inject="device_error:0x9"))
+
+    deadline = time.monotonic() + 240.0
+    status = {}
+    while time.monotonic() < deadline:
+        docs = {d["job_id"]: d for d in daemon.list()["jobs"]}
+        status = {jid: docs.get(jid, {}).get("status")
+                  for jid in (clean, faulted)}
+        if all(s in ("done", "failed", "cancelled")
+               for s in status.values()):
+            break
+        time.sleep(0.1)
+    if status.get(clean) != "done" or status.get(faulted) != "failed":
+        raise ChaosFailure(
+            "serve-device-error: wanted the clean job done and the "
+            f"faulted job failed, got {status}")
+    if "device_error" not in _journal_incidents(cfg["serve_root"],
+                                                faulted):
+        raise ChaosFailure(
+            "serve-device-error: no device_error incident in the "
+            "faulted job's journal")
+    if "device_error" in _journal_incidents(cfg["serve_root"], clean):
+        raise ChaosFailure(
+            "serve-device-error: a device_error incident leaked into "
+            "the clean job's journal")
+    with urllib.request.urlopen(f"{base}/jobs/{clean}/peaks",
+                                timeout=10.0) as resp:
+        payload = resp.read()
+    with open(cfg["peaks_csv"], "wb") as fobj:
+        fobj.write(payload)
+    daemon.stop()
+    return 0
+
+
 def _leg_main(cfg_path):
     """One subprocess leg: install the leg's fault plan into fsio and
     the journal as the incident sink, optionally probe the exec cache,
@@ -518,6 +707,10 @@ def _leg_main(cfg_path):
 
     if cfg.get("serve"):
         logging.basicConfig(level="INFO")
+        if cfg.get("serve_drain"):
+            return _serve_drain_leg_main(cfg)
+        if cfg.get("serve_device_error"):
+            return _serve_device_error_leg_main(cfg)
         return _serve_leg_main(cfg)
 
     from ..obs import trace

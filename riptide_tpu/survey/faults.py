@@ -42,7 +42,14 @@ Spec grammar: comma-separated directives, each
 * ``peer_loss:3``   raise :class:`InjectedPeerLoss` at chunk 3's peak
   gather, simulating a bounded collective timing out on a dead peer —
   the multihost layer degrades to local-only mode (see
-  riptide_tpu/parallel/multihost.py).
+  riptide_tpu/parallel/multihost.py);
+* ``device_error:2``  raise :class:`InjectedDeviceError` dispatching
+  chunk 2: a NON-OOM, non-timeout XLA-shaped runtime error (message
+  carries the ``INTERNAL:`` marker). The scheduler classifies it via
+  ``is_device_error``, evicts the resident exec-cache entries and
+  re-fires the chunk through the ordinary retry path; ``x9`` (more
+  firings than retries) exhausts the retries and fails the run/job
+  with a ``device_error`` incident.
 
 **Storage faults** target a persistence *site* (a name from
 :data:`riptide_tpu.utils.fsio.SITES`) instead of a chunk id, and fire
@@ -80,13 +87,13 @@ import numpy as np
 from ..utils import fsio
 from .liveness import PeerTimeout
 
-__all__ = ["FaultPlan", "FaultAbort", "InjectedFault", "InjectedOOM",
-           "InjectedPeerLoss"]
+__all__ = ["FaultPlan", "FaultAbort", "InjectedDeviceError",
+           "InjectedFault", "InjectedOOM", "InjectedPeerLoss"]
 
 log = logging.getLogger("riptide_tpu.survey.faults")
 
 _KINDS = ("raise", "stall", "corrupt", "abort", "nan_inject", "oom",
-          "hang", "straggle", "peer_loss",
+          "hang", "straggle", "peer_loss", "device_error",
           "torn_write", "enospc", "fsync_fail", "kill_at",
           "cache_corrupt")
 
@@ -125,6 +132,19 @@ class InjectedPeerLoss(PeerTimeout):
         super().__init__(
             f"injected peer loss at chunk {chunk_id}'s gather "
             "(simulated bounded-collective timeout)"
+        )
+
+
+class InjectedDeviceError(RuntimeError):
+    """Simulated non-OOM device runtime error: the message carries the
+    ``INTERNAL:`` marker of an XLA runtime failure (and none of the
+    OOM/timeout markers), so it routes through the same
+    ``is_device_error`` classification as a real ``XlaRuntimeError``."""
+
+    def __init__(self, chunk_id):
+        super().__init__(
+            f"INTERNAL: injected XLA device error on chunk {chunk_id} "
+            "(simulated device runtime failure)"
         )
 
 
@@ -234,6 +254,10 @@ class FaultPlan:
             log.warning("fault injection: transient error on chunk %d",
                         chunk_id)
             raise InjectedFault(f"injected device error on chunk {chunk_id}")
+        if self._take("device_error", chunk_id) is not None:
+            log.warning("fault injection: device runtime error on chunk %d",
+                        chunk_id)
+            raise InjectedDeviceError(chunk_id)
 
     def in_flight(self, chunk_id):
         """Called inside the watchdog-guarded dispatch region (the

@@ -18,15 +18,18 @@ record::
 correlated with the exact span in an exported Chrome trace; it is None
 while tracing is disabled.
 
-Emission is decoupled from storage: the survey scheduler (and the
-journaled rseek path) install the journal's
-:meth:`~riptide_tpu.survey.journal.SurveyJournal.record_incident` as
-the process-wide *sink* for the duration of a run, so incidents fired
-anywhere down-stack (batcher OOM bisection, data-quality quarantine,
-multihost peer loss) land in the journal next to the chunk records.
-With no sink installed (non-journaled runs) an incident still bumps the
-``incidents`` counter and is retained as :func:`last_incident` for the
-``/status`` surface — it is never an error to emit one.
+Emission is decoupled from storage, with two sink layers (PR 17).
+If the emitting thread belongs to a job-scoped
+:class:`~riptide_tpu.utils.runctx.RunContext` (installed by
+``SurveyScheduler.run()`` and per service job by ``ServeDaemon``),
+that context's ``incident_sink`` receives the record — so two
+concurrent service jobs each journal ONLY their own incidents.
+Otherwise the process-wide sink installed via :func:`set_sink` (the
+pre-PR-17 behavior, still what every batch CLI path uses) applies.
+With no sink at either layer (non-journaled runs) an incident still
+bumps the ``incidents`` counter and is retained as
+:func:`last_incident` for the ``/status`` surface — it is never an
+error to emit one.
 
 Old journal readers are tolerant by construction: every reader filters
 records by ``kind``, so ``incident`` lines are invisible to pre-PR-9
@@ -35,6 +38,7 @@ code, and journals without them read back an empty incident list.
 import logging
 import threading
 
+from ..utils import runctx
 from .journal import _utc_iso
 from .metrics import get_metrics
 
@@ -62,6 +66,8 @@ INCIDENT_KINDS = (
     "job_rejected",       # serve: admission refused (capacity/quota)
     "quota_exceeded",     # serve: tenant device-seconds budget exhausted
     "job_cancelled",      # serve: job cancelled at a chunk boundary
+    "job_timeout",        # serve: per-job deadline_s exceeded at the gate
+    "device_error",       # scheduler: non-OOM device runtime error exhausted
 )
 
 _lock = threading.Lock()
@@ -117,6 +123,14 @@ def emit(kind, chunk_id=None, **detail):
     with _lock:
         _last = rec
         sink = _sink
+    # Context-first resolution (PR 17): a thread owned by a run context
+    # journals into ITS sink; the process-global sink stays the
+    # fallback so batch paths are byte-unchanged.
+    ctx = runctx.current()
+    if ctx is not None:
+        ctx.note_incident(rec)
+        if ctx.incident_sink is not None:
+            sink = ctx.incident_sink
     log.warning("incident: %s%s", kind,
                 f" (chunk {chunk_id})" if chunk_id is not None else "")
     if sink is not None:

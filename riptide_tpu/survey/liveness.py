@@ -37,6 +37,7 @@ import logging
 import threading
 import time
 
+from ..utils import runctx
 from .metrics import get_metrics
 
 log = logging.getLogger("riptide_tpu.survey.liveness")
@@ -44,7 +45,8 @@ log = logging.getLogger("riptide_tpu.survey.liveness")
 __all__ = [
     "ChunkTimeout", "PeerTimeout", "Deadline", "DurationEWMA",
     "ChunkWatchdog", "bounded_wait", "bounded_allgather",
-    "barrier_with_timeout", "PeerLivenessMonitor", "is_timeout_error",
+    "barrier_with_timeout", "PeerLivenessMonitor", "is_device_error",
+    "is_timeout_error",
 ]
 
 # Substrings identifying a deadline/hang condition in an exception
@@ -65,6 +67,35 @@ def is_timeout_error(err):
         return True
     msg = str(err).lower()
     return any(marker in msg for marker in _TIMEOUT_MARKERS)
+
+
+# Substrings of an XLA runtime failure that is neither memory pressure
+# nor a hang: a wedged/reset device, a poisoned compiled executable, a
+# failed transfer. The OOM markers are repeated here (engine.py owns
+# is_oom_error, but importing it would pull jax into this stdlib-only
+# module) purely to EXCLUDE them.
+_DEVICE_ERROR_MARKERS = ("internal:", "failed_precondition",
+                         "failed precondition", "aborted:",
+                         "unavailable:", "data loss", "data_loss",
+                         "xlaruntimeerror")
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted",
+                "out of memory")
+
+
+def is_device_error(err):
+    """True when an exception looks like a NON-OOM, non-timeout device
+    runtime error (``XlaRuntimeError: INTERNAL ...``, a reset device, a
+    failed transfer). Such errors are retryable once the implicated
+    compiled executables are dropped — the scheduler evicts the
+    resident exec-cache entries and re-fires the chunk through the
+    ordinary retry path; repeated failure is a ``device_error``
+    incident, failing only the run (service job) that hit it."""
+    if is_timeout_error(err):
+        return False
+    msg = str(err).lower()
+    if any(marker in msg for marker in _OOM_MARKERS):
+        return False
+    return any(marker in msg for marker in _DEVICE_ERROR_MARKERS)
 
 
 class ChunkTimeout(RuntimeError):
@@ -293,7 +324,11 @@ def _run_sacrificial(fn, timeout_s, name):
         finally:
             done.set()
 
-    worker = threading.Thread(target=attempt, daemon=True, name=name)
+    # runctx.wrap: the sacrificial thread inherits the caller's
+    # job-scoped run context, so incidents it emits (OOM bisection,
+    # quarantine, cache heal) journal into the owning run.
+    worker = threading.Thread(target=runctx.wrap(attempt), daemon=True,
+                              name=name)
     worker.start()
     return done.wait(float(timeout_s)), box
 
@@ -449,7 +484,10 @@ class PeerLivenessMonitor:
                 self.beat_retrying()
 
         self.beat_retrying()
-        threading.Thread(target=beater, daemon=True,
+        # runctx.wrap: the beater inherits the starting run's context,
+        # so its give-up obs_write_failed incidents attribute to the
+        # run whose journal it is beating for.
+        threading.Thread(target=runctx.wrap(beater), daemon=True,
                          name=f"heartbeat-{self.process_index}").start()
         self._beater_stop = stop
 

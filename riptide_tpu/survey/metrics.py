@@ -36,11 +36,12 @@ contains whatever was recorded):
 ``breaker_opens``         counter: circuit-breaker closed/half-open -> open
 ``chunks_parked``         counter: chunks set aside by the open breaker
 ``peer_losses``           counter: collectives degraded to local-only mode
+``device_errors``         counter: non-OOM XLA runtime errors hit in dispatch
 ``incidents``             counter: structured incident records emitted
 ``heartbeat_age_s``       gauge: age of the stalest peer heartbeat
 ========================  ====================================================
 
-The liveness counters (``chunks_timed_out`` .. ``peer_losses``) are
+The liveness counters (``chunks_timed_out`` .. ``device_errors``) are
 always present in :meth:`summary` (zero when nothing fired) so survey
 health dashboards and the bench JSON sub-metrics block have a stable
 schema.
@@ -202,7 +203,7 @@ class MetricsRegistry:
         # Survey-health counters keep a stable schema: always present,
         # zero when the corresponding machinery never fired.
         for name in ("chunks_timed_out", "breaker_opens", "chunks_parked",
-                     "peer_losses", "incidents"):
+                     "peer_losses", "device_errors", "incidents"):
             out.setdefault(name, 0)
         return out
 

@@ -54,10 +54,10 @@ from ..obs.alerts import AlertEngine, install_engine, rules_from_spec
 from ..obs.chrome import export_run_trace
 from ..obs.schema import chunk_timing
 from ..obs.trace import span
-from ..utils import envflags, fsio
+from ..utils import envflags, fsio, runctx
 from . import incidents
 from .faults import FaultAbort, FaultPlan
-from .liveness import is_timeout_error
+from .liveness import is_device_error, is_timeout_error
 from .metrics import get_metrics
 
 log = logging.getLogger("riptide_tpu.survey.scheduler")
@@ -125,8 +125,9 @@ def run_with_retry(work, chunk_id, retry, faults, metrics, on_retry=None):
     """The ONE retry/backoff loop around a work unit's dispatch, shared
     by the chunk scheduler and the rseek CLI: fires the fault plan's
     dispatch trigger, runs ``work()``, and on a retryable failure backs
-    off, bumps ``chunks_retried``, calls ``on_retry`` (recovery hook,
-    e.g. re-preparing a corrupted buffer) and tries again.
+    off, bumps ``chunks_retried``, calls ``on_retry(err)`` (recovery
+    hook, e.g. re-preparing a corrupted buffer, or evicting resident
+    executables after a device runtime error) and tries again.
     ``KeyboardInterrupt``/``SystemExit`` re-raise immediately — an
     operator interrupt must never be "retried" or slept through — as do
     :class:`FaultAbort` and exhausted retries. Watchdog/device timeouts
@@ -148,6 +149,11 @@ def run_with_retry(work, chunk_id, retry, faults, metrics, on_retry=None):
                 # Hang rate is a first-class survey health signal,
                 # tracked apart from generic transient retries.
                 metrics.add("chunks_timed_out")
+            elif is_device_error(err):
+                # Non-OOM device runtime errors get their own count:
+                # the recovery hook evicts resident executables before
+                # the re-fire (see SurveyScheduler._dispatch_with_retry).
+                metrics.add("device_errors")
             if not getattr(err, "retryable", True):
                 # e.g. QuarantinedSeries: re-dispatching cannot fix the
                 # data, so propagate instead of burning retries.
@@ -173,7 +179,7 @@ def run_with_retry(work, chunk_id, retry, faults, metrics, on_retry=None):
             )
             retry._sleep(delay)
             if on_retry is not None:
-                on_retry()
+                on_retry(err)
             attempt += 1
 
 
@@ -380,6 +386,10 @@ class SurveyScheduler:
         # alert engine the same watch_snapshot rwatch derives from
         # another process (None while alerting is off).
         self._follower = None
+        # This run's job-scoped RunContext (built by run()): status()
+        # reads ITS last incident so a sibling run can never clobber
+        # this run's /status tail.
+        self._ctx = None
 
     # -- staging ------------------------------------------------------------
 
@@ -399,8 +409,8 @@ class SurveyScheduler:
                 span("stage", chunk=chunk_id):
             tslist = [
                 ts for ts in loaders.map(
-                    lambda f: self.searcher.load_prepared(
-                        f, chunk_id=chunk_id),
+                    runctx.wrap(lambda f: self.searcher.load_prepared(
+                        f, chunk_id=chunk_id)),
                     fnames,
                 )
                 if ts is not None
@@ -484,7 +494,19 @@ class SurveyScheduler:
             return self._dispatch_once(chunk_id, state["items"],
                                        state["digest"])
 
-        def recover():
+        def recover(err=None):
+            if err is not None and is_device_error(err):
+                # A non-OOM device runtime error poisons the LOADED
+                # executables, not the host data: drop every resident
+                # compiled program so the re-fired attempt deserializes
+                # (or recompiles) fresh ones instead of re-dispatching
+                # onto a wedged one. Lazy import: exec_cache pulls jax.
+                from ..utils import exec_cache
+                n = exec_cache.evict_resident(
+                    reason=f"device error on chunk {chunk_id}")
+                log.warning(
+                    "chunk %d: device error classified; evicted %d "
+                    "resident executable(s) before re-fire", chunk_id, n)
             if state["digest"] is not None \
                     and _wire_digest(state["items"]) != state["digest"]:
                 # Corrupted prepared buffer: rebuild from host data.
@@ -650,7 +672,12 @@ class SurveyScheduler:
             "eta_s": None if ewma is None else round(remaining * ewma, 1),
             "breaker": (self.breaker.state
                         if self.breaker is not None else None),
-            "last_incident": incidents.last_incident(),
+            # Context-first: with a run context built (run() started),
+            # only incidents attributed to THIS run appear; the global
+            # tail is the fallback for a scheduler queried before run().
+            "last_incident": (self._ctx.last_incident()
+                              if self._ctx is not None
+                              else incidents.last_incident()),
         }
         if self.alerts is not None:
             status["alerts"] = self.alerts.active()
@@ -676,13 +703,19 @@ class SurveyScheduler:
         order (journal-replayed and freshly-searched chunks interleave
         exactly as an uninterrupted run would produce them).
 
-        For the run's duration the journal is installed as the
-        process-wide incident sink (so watchdog/breaker/OOM/quarantine/
-        peer-loss incidents emitted anywhere down-stack are journaled
-        with the chunk records) and — unless ``RIPTIDE_STATUS=0`` —
-        :meth:`status` is registered as the live ``/status`` source on
-        the Prometheus endpoint (the provider stays registered after
-        the run, so a final state remains queryable)."""
+        For the run's duration a job-scoped
+        :class:`~riptide_tpu.utils.runctx.RunContext` owns the calling
+        thread (inherited by the stager/loader pool and any watchdog or
+        beater thread it starts): incidents emitted anywhere down-stack
+        journal into THIS run's journal even with sibling runs in
+        flight, and storage-fault directives resolve this run's plan.
+        The journal is ALSO installed as the process-wide incident sink
+        and the plan as the process-wide storage hook — the pre-PR-17
+        fallback layer, so context-free threads and batch paths behave
+        unchanged. Unless ``RIPTIDE_STATUS=0``, :meth:`status` is
+        registered as the live ``/status`` source on the Prometheus
+        endpoint (the provider stays registered after the run, so a
+        final state remains queryable)."""
         # Build (and so VALIDATE) the alert engine before any
         # process-wide hook is installed: a typo'd RIPTIDE_ALERT_RULES
         # must fail this run without leaking the incident sink or the
@@ -719,6 +752,19 @@ class SurveyScheduler:
         if fleet_directory is not None and fleet.enabled():
             prom.set_fleet_source(
                 lambda: obs_report.read_fleet(fleet_directory))
+        # The job-scoped layer: this run's context on the calling
+        # thread (and, via runctx.wrap, on every worker thread the run
+        # starts). The process-global installs above stay as the
+        # fallback so pre-PR-17 behavior is byte-unchanged when no
+        # sibling run is in flight.
+        self._ctx = runctx.RunContext(
+            incident_sink=(self.journal.record_incident
+                           if self.journal is not None else None),
+            status_provider=self.status,
+            storage_faults=self.faults.storage_op,
+            label=self.survey_id,
+        )
+        prev_ctx = runctx.install(self._ctx)
         self._running = True
         try:
             return self._run()
@@ -728,6 +774,7 @@ class SurveyScheduler:
             # Final sidecar: the at-rest record of this process
             # (running=false, final counters) for late readers.
             self._fleet_safe()
+            runctx.install(prev_ctx)
             fsio.set_storage_faults(prev_hook)
             if sink_set:
                 incidents.set_sink(prev_sink)
@@ -771,10 +818,15 @@ class SurveyScheduler:
         # port is offset by this process's index so co-hosted
         # processes each get their own endpoint.
         prom.maybe_serve(self.metrics, process_index=self.process_index)
+        # Run-context inheritance into the staging thread: pool workers
+        # have empty thread-locals, so the submitted callable carries
+        # this thread's context in (and _stage re-wraps the per-file
+        # load for the loader pool).
+        stage = runctx.wrap(self._stage)
         with ThreadPoolExecutor(max_workers=1) as stager, \
                 ThreadPoolExecutor(max_workers=self.searcher.io_threads) \
                 as loaders:
-            staged = (stager.submit(self._stage, loaders,
+            staged = (stager.submit(stage, loaders,
                                     self.chunks[pending[0]], pending[0])
                       if pending else None)
             for k, cid in enumerate(pending):
@@ -782,7 +834,7 @@ class SurveyScheduler:
                 tslist, items, digest, prep_s = staged.result()
                 if k + 1 < len(pending):
                     staged = stager.submit(
-                        self._stage, loaders, self.chunks[pending[k + 1]],
+                        stage, loaders, self.chunks[pending[k + 1]],
                         pending[k + 1],
                     )
                 self._heartbeat_safe()
@@ -810,6 +862,15 @@ class SurveyScheduler:
                     except (KeyboardInterrupt, SystemExit, FaultAbort):
                         raise
                     except Exception as err:
+                        if is_device_error(err):
+                            # The retries (each of which evicted the
+                            # resident executables) did not clear it:
+                            # attribute the failure as a device_error
+                            # incident. In serve mode the raise below
+                            # fails only THIS job — the daemon keeps
+                            # serving the rest of the queue.
+                            incidents.emit("device_error", chunk_id=cid,
+                                           error=str(err))
                         if self.breaker is None:
                             raise
                         # Breaker configured: a chunk that exhausted its

@@ -357,6 +357,15 @@ _ALL = [
         "required.",
         since="PR 16 (0.15.0)",
     ),
+    EnvFlag(
+        "RIPTIDE_SERVE_DRAIN_TIMEOUT_S", "float", 60.0,
+        "Graceful-drain budget of the survey service daemon: on "
+        "SIGTERM/SIGINT or POST /drain, how long to wait for the "
+        "running chunk to finish and queued jobs to park at the chunk "
+        "gate before rserve exits anyway. Parked jobs keep no terminal "
+        "registry record, so a restart re-queues them (`resumed`).",
+        since="PR 17 (0.16.0)",
+    ),
 ]
 
 FLAGS = {f.name: f for f in _ALL}

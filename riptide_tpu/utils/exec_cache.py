@@ -31,6 +31,7 @@ import stat
 import tempfile
 import threading
 import time
+import weakref
 
 import jax
 
@@ -387,6 +388,33 @@ def _bump_warmth(fresh):
         pass
 
 
+# Every live _Cached wrapper, so a device-error recovery can drop ALL
+# resident executables at once (weak: wrappers normally live as
+# module-level decorated functions, but nothing must pin a dynamically
+# created one).
+_wrappers = weakref.WeakSet()
+
+
+def evict_resident(reason=None):
+    """Drop every resident (in-memory) compiled executable from every
+    live ``cached_jit`` wrapper, forcing the next call of each to
+    reload/recompile. The device-error recovery path (PR 17): after a
+    non-OOM XLA runtime error the loaded device programs are suspect —
+    the serialized on-disk entries are not (they were framed at compile
+    time), so the disk layer stays and the rebuild is a deserialize,
+    not a recompile. Returns the number of executables dropped; the
+    warm/cold accounting (``_seen``) is untouched."""
+    dropped = 0
+    with _lock:
+        for wrapper in list(_wrappers):
+            dropped += len(wrapper._mem)
+            wrapper._mem.clear()
+    if dropped or reason:
+        log.warning("evicted %d resident executable(s)%s", dropped,
+                    f" ({reason})" if reason else "")
+    return dropped
+
+
 class _Cached:
     def __init__(self, jitted, name):
         self.jitted = jitted
@@ -395,6 +423,7 @@ class _Cached:
         # Keys this wrapper has already served: the warm/cold split the
         # serve daemon's warm-start assertion reads (see __call__).
         self._seen = set()
+        _wrappers.add(self)
 
     def _key(self, flat_args):
         parts = [self.name, _src_hash(), jax.devices()[0].platform,

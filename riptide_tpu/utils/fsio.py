@@ -162,7 +162,17 @@ def scan_jsonl(path):
 # ``exit(KILL_EXIT)`` (a mid-write kill) or raise EIO (a torn write the
 # caller survives). Installed process-wide by the survey layers for the
 # duration of a run; ``None`` (the default) costs one attribute read.
+#
+# PR 17: a thread owned by a job-scoped RunContext (utils.runctx)
+# resolves its ``storage_faults`` plan FIRST, so two concurrent service
+# jobs each see only their own injected plan; the process-wide hook
+# stays the fallback layer for batch paths.
 # ---------------------------------------------------------------------------
+
+try:  # fsio stays usable standalone; runctx is stdlib-only anyway
+    from . import runctx as _runctx
+except ImportError:  # pragma: no cover - standalone module use
+    _runctx = None
 
 _fault_hook = None
 # Reentrancy guard: healing a torn tail emits an incident, whose sink
@@ -181,8 +191,14 @@ def set_storage_faults(hook):
 
 
 def _fire(op, site, path):
+    if site is None:
+        return None
+    if _runctx is not None:
+        ctx = _runctx.current()
+        if ctx is not None and ctx.storage_faults is not None:
+            return ctx.storage_faults(op, site, path)
     hook = _fault_hook
-    if hook is None or site is None:
+    if hook is None:
         return None
     return hook(op, site, path)
 

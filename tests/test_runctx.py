@@ -1,0 +1,141 @@
+"""
+Job-scoped run contexts (riptide_tpu/utils/runctx.py): thread-local
+resolution of the incident sink and storage-fault plan, inheritance
+into worker threads via ``runctx.wrap``, and the process-global
+fallback layer that keeps batch CLI behaviour unchanged.
+"""
+import threading
+
+import pytest
+
+from riptide_tpu.survey import incidents
+from riptide_tpu.utils import fsio, runctx
+
+
+@pytest.fixture(autouse=True)
+def _clean_context():
+    """Every test starts and ends with no context installed."""
+    prev = runctx.install(None)
+    yield
+    runctx.install(prev)
+
+
+def test_install_and_current_roundtrip():
+    assert runctx.current() is None
+    ctx = runctx.RunContext(label="t1")
+    prev = runctx.install(ctx)
+    assert prev is None
+    assert runctx.current() is ctx
+    assert runctx.install(prev) is ctx
+    assert runctx.current() is None
+
+
+def test_activate_restores_previous_context():
+    outer = runctx.RunContext(label="outer")
+    runctx.install(outer)
+    inner = runctx.RunContext(label="inner")
+    with runctx.activate(inner):
+        assert runctx.current() is inner
+    assert runctx.current() is outer
+    # ...even when the body raises.
+    with pytest.raises(RuntimeError):
+        with runctx.activate(inner):
+            raise RuntimeError("boom")
+    assert runctx.current() is outer
+
+
+def test_wrap_inherits_context_into_thread():
+    ctx = runctx.RunContext(label="parent")
+    runctx.install(ctx)
+    seen = {}
+
+    def probe():
+        seen["ctx"] = runctx.current()
+
+    t = threading.Thread(target=runctx.wrap(probe))
+    t.start()
+    t.join()
+    assert seen["ctx"] is ctx
+    # A bare (unwrapped) thread inherits NOTHING — thread-local means
+    # thread-local.
+    t = threading.Thread(target=probe)
+    t.start()
+    t.join()
+    assert seen["ctx"] is None
+
+
+def test_wrap_restores_the_executing_threads_context():
+    mine = runctx.RunContext(label="mine")
+    theirs = runctx.RunContext(label="theirs")
+    runctx.install(mine)
+    fn = runctx.wrap(lambda: None, ctx=theirs)
+    fn()
+    assert runctx.current() is mine
+
+
+def test_incident_emit_prefers_context_sink():
+    ctx_records, global_records = [], []
+    prev = incidents.set_sink(global_records.append)
+    try:
+        ctx = runctx.RunContext(incident_sink=ctx_records.append,
+                                label="job-a")
+        with runctx.activate(ctx):
+            incidents.emit("watchdog_timeout", chunk_id=1, budget_s=2.0)
+        incidents.emit("watchdog_timeout", chunk_id=2, budget_s=3.0)
+    finally:
+        incidents.set_sink(prev)
+    # In-context emission went to the context's sink ONLY; outside the
+    # context the process-global fallback received it — the batch path.
+    assert [r["chunk_id"] for r in ctx_records] == [1]
+    assert [r["chunk_id"] for r in global_records] == [2]
+    # The context retains its own last incident for status surfaces.
+    assert ctx.last_incident()["chunk_id"] == 1
+
+
+def test_incident_emit_context_without_sink_falls_back():
+    global_records = []
+    prev = incidents.set_sink(global_records.append)
+    try:
+        with runctx.activate(runctx.RunContext(label="sinkless")):
+            incidents.emit("breaker_open", cooldown_s=1.0)
+    finally:
+        incidents.set_sink(prev)
+    assert len(global_records) == 1
+
+
+def test_fsio_fire_prefers_context_fault_plan(tmp_path):
+    from riptide_tpu.survey.faults import FaultPlan
+
+    target = str(tmp_path / "hb.jsonl")
+    plan = FaultPlan.parse("enospc:heartbeat_append")
+    ctx = runctx.RunContext(storage_faults=plan.storage_op)
+    with runctx.activate(ctx):
+        with pytest.raises(OSError, match="ENOSPC"):
+            fsio.append_bytes(target, b"beat\n", site="heartbeat_append")
+    # The plan was scoped to the context: the same write outside it
+    # (no global hook installed) is clean.
+    fsio.append_bytes(target, b"beat\n", site="heartbeat_append")
+    with open(target, "rb") as fobj:
+        assert fobj.read() == b"beat\n"
+
+
+def test_fsio_fire_global_fallback_without_context(tmp_path):
+    from riptide_tpu.survey.faults import FaultPlan
+
+    target = str(tmp_path / "hb.jsonl")
+    plan = FaultPlan.parse("enospc:heartbeat_append")
+    prev = fsio.set_storage_faults(plan.storage_op)
+    try:
+        with pytest.raises(OSError, match="ENOSPC"):
+            fsio.append_bytes(target, b"beat\n", site="heartbeat_append")
+    finally:
+        fsio.set_storage_faults(prev)
+
+
+def test_note_incident_copies_and_is_thread_safe():
+    ctx = runctx.RunContext()
+    rec = {"incident": "quarantine", "detail": {"fname": "x"}}
+    ctx.note_incident(rec)
+    rec["incident"] = "mutated-after-noting"
+    assert ctx.last_incident()["incident"] == "quarantine"
+    assert ctx.last_incident() is not ctx.last_incident()  # copies out

@@ -22,7 +22,8 @@ from riptide_tpu.serve import ServeDaemon, FairShareQueue, TenantTable
 from riptide_tpu.serve.daemon import (
     fold_job_events, geometry_key, job_record,
 )
-from riptide_tpu.serve.queue import JobCancelled, QuotaExceeded
+from riptide_tpu.serve.queue import (JobCancelled, JobDeadlineExceeded,
+                                     JobDrained, QuotaExceeded)
 from riptide_tpu.survey import incidents
 from riptide_tpu.survey.journal import SurveyJournal
 from riptide_tpu.survey.metrics import get_metrics
@@ -57,20 +58,30 @@ def _spec(files, tenant="default", priority=0):
             "search": SEARCH}
 
 
-def _req(base, path, method="GET", body=None, timeout=10.0):
+def _req_full(base, path, method="GET", body=None, timeout=10.0,
+              headers=None):
+    """(status, body_bytes, response_headers) — the header-asserting
+    variant (Retry-After back-pressure, Idempotency-Key replays)."""
     data = json.dumps(body).encode() if body is not None else None
-    req = urllib.request.Request(
-        base + path, data=data, method=method,
-        headers={"Content-Type": "application/json"} if data else {})
+    hdrs = {"Content-Type": "application/json"} if data else {}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(base + path, data=data, method=method,
+                                 headers=hdrs)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, resp.read()
+            return resp.status, resp.read(), dict(resp.headers)
     except urllib.error.HTTPError as err:
-        return err.code, err.read()
+        return err.code, err.read(), dict(err.headers)
 
 
-def _req_json(base, path, method="GET", body=None):
-    code, raw = _req(base, path, method=method, body=body)
+def _req(base, path, method="GET", body=None, timeout=10.0, headers=None):
+    code, raw, _ = _req_full(base, path, method=method, body=body,
+                             timeout=timeout, headers=headers)
+    return code, raw
+
+
+def _req_json(base, path, method="GET", body=None, headers=None):
+    code, raw = _req(base, path, method=method, body=body, headers=headers)
     return code, json.loads(raw)
 
 
@@ -153,6 +164,27 @@ def test_queue_cancel_raises_at_begin():
     q.cancel("j1")
     with pytest.raises(JobCancelled):
         gate.begin(0)
+
+
+def test_queue_drain_raises_at_begin():
+    q = FairShareQueue()
+    gate = q.register("j1")
+    q.drain()
+    assert q.draining
+    with pytest.raises(JobDrained):
+        gate.begin(0)
+
+
+def test_queue_deadline_raises_at_begin():
+    q = FairShareQueue()
+    gate = q.register("j1", deadline_s=0.01)
+    time.sleep(0.03)
+    with pytest.raises(JobDeadlineExceeded):
+        gate.begin(0)
+    # An unexpired deadline admits normally.
+    gate2 = FairShareQueue().register("j2", deadline_s=60.0)
+    gate2.begin(0)
+    gate2.end(0)
 
 
 def test_tenant_quota_admission_and_budget():
@@ -280,17 +312,12 @@ def test_admission_rejection_and_incident(daemon, data_files):
 
 
 def test_runtime_quota_stops_at_chunk_boundary(daemon, data_files):
-    captured = []
-    prev = incidents.set_sink(captured.append)
-    try:
-        tenants = TenantTable(budget_device_s=1e-6)
-        d, base = daemon(workers=1, tenants=tenants)
-        code, doc = _req_json(base, "/jobs", "POST",
-                              _spec(data_files, tenant="meter"))
-        assert code == 202
-        doc = _wait_terminal(base, doc["job_id"])
-    finally:
-        incidents.set_sink(prev)
+    tenants = TenantTable(budget_device_s=1e-6)
+    d, base = daemon(workers=1, tenants=tenants)
+    code, doc = _req_json(base, "/jobs", "POST",
+                          _spec(data_files, tenant="meter"))
+    assert code == 202
+    doc = _wait_terminal(base, doc["job_id"])
     # The first chunk's turn exhausts the micro-budget; the stop lands
     # at the NEXT chunk boundary, so the journal keeps the completed
     # chunk and stays resumable.
@@ -299,7 +326,10 @@ def test_runtime_quota_stops_at_chunk_boundary(daemon, data_files):
     j = SurveyJournal(doc["directory"])
     done = j.completed_chunks()
     assert 0 < len(done) < len(DMS)
-    assert any(rec["incident"] == "quota_exceeded" for rec in captured)
+    # Job-scoped attribution: the incident lands in the job's OWN
+    # journal (its RunContext sink), not the process-global fallback.
+    assert any(rec["incident"] == "quota_exceeded"
+               for rec in j.incidents())
 
 
 def _spin(predicate, timeout_s=120.0, tick=0.02):
@@ -392,6 +422,190 @@ def test_restart_requeues_unfinished_jobs(daemon, data_files):
     assert doc2["job_id"] != jid
     code, payload = _req(base2, f"/jobs/{jid}/peaks")
     assert code == 200 and payload.startswith(b"period,")
+
+
+def test_idempotent_submission_dedupes_across_restart(daemon, data_files):
+    d1, base1 = daemon(workers=0)
+    hdr = {"Idempotency-Key": "key-abc"}
+    code, doc = _req_json(base1, "/jobs", "POST", _spec(data_files[:1]),
+                          headers=hdr)
+    assert code == 202
+    jid = doc["job_id"]
+    # A retried submit with the same key answers with the EXISTING
+    # job's document — no second enqueue.
+    code, doc2 = _req_json(base1, "/jobs", "POST", _spec(data_files[:1]),
+                           headers=hdr)
+    assert code == 202 and doc2["job_id"] == jid
+    # A different key is a genuinely new job.
+    code, doc3 = _req_json(base1, "/jobs", "POST", _spec(data_files[:1]),
+                           headers={"Idempotency-Key": "key-def"})
+    assert code == 202 and doc3["job_id"] != jid
+    code, listing = _req_json(base1, "/jobs")
+    assert len(listing["jobs"]) == 2
+    d1.stop()
+    # The dedupe map is rebuilt from the replayed registry, so a client
+    # retrying ACROSS a daemon restart still dedupes.
+    d2, base2 = daemon(workers=0)
+    code, doc4 = _req_json(base2, "/jobs", "POST", _spec(data_files[:1]),
+                           headers=hdr)
+    assert code == 202 and doc4["job_id"] == jid
+
+
+def test_backpressure_carries_retry_after(daemon, data_files):
+    d, base = daemon(workers=0, max_jobs=1)
+    code, _, _ = _req_full(base, "/jobs", "POST", _spec(data_files[:1]))
+    assert code == 202
+    # The resident-cap 429 advises when to retry — header and body
+    # agree (the header is what generic HTTP clients honour).
+    code, raw, hdrs = _req_full(base, "/jobs", "POST",
+                                _spec(data_files[:1]))
+    doc = json.loads(raw)
+    assert code == 429
+    assert doc["retry_after_s"] > 0
+    assert hdrs.get("Retry-After") == str(doc["retry_after_s"])
+
+
+def test_deadline_fails_job_with_timeout_incident(daemon, data_files):
+    d, base = daemon(workers=1)
+    # A non-positive deadline is a spec error, not an enqueue.
+    code, doc = _req_json(base, "/jobs", "POST",
+                          dict(_spec(data_files[:1]), deadline_s=-1))
+    assert code == 400 and "deadline_s" in doc["error"]
+    # The blocker holds the device turn past the micro-deadline, so
+    # the job expires deterministically at its FIRST begin() — the
+    # gate checks the deadline while parked, no chunk ever runs.
+    blocker = d.queue.register("blocker", priority=-1)
+    blocker.begin(0)
+    try:
+        code, doc = _req_json(base, "/jobs", "POST",
+                              dict(_spec(data_files[:1]), deadline_s=0.2))
+        assert code == 202
+        doc = _wait_terminal(base, doc["job_id"], timeout_s=30.0)
+    finally:
+        blocker.end(0)
+        d.queue.unregister("blocker")
+    assert doc["status"] == "failed"
+    assert "deadline" in doc["error"]
+    # The job_timeout incident is journaled in the job's own directory.
+    j = SurveyJournal(doc["directory"])
+    assert any(rec["incident"] == "job_timeout" for rec in j.incidents())
+    assert j.completed_chunks() == {}
+
+
+def test_drain_parks_job_and_restart_resumes(daemon, data_files):
+    d, base = daemon(workers=1)
+    # Blocker-stepped as in the cancellation test: the job completes
+    # exactly chunk 0, then freezes at the gate — the drain provably
+    # lands mid-job.
+    blocker = d.queue.register("blocker", priority=-1)
+    blocker.begin(0)
+    code, doc = _req_json(base, "/jobs", "POST", _spec(data_files))
+    assert code == 202
+    jid = doc["job_id"]
+    assert _spin(lambda: d.queue.snapshot()["jobs"]
+                 .get(jid, {}).get("waiting"))
+    blocker.end(0)
+    assert _spin(lambda: d.queue.snapshot()["active"] == jid)
+    t = threading.Thread(target=lambda: blocker.begin(1), daemon=True)
+    t.start()
+    assert _spin(lambda: d.queue.snapshot()["active"] == "blocker")
+    # POST /drain: admission stops with a Retry-After'd 503...
+    code, doc = _req_json(base, "/drain", "POST", {})
+    assert code == 202 and doc["draining"] is True
+    code, raw, hdrs = _req_full(base, "/jobs", "POST",
+                                _spec(data_files[:1]))
+    body = json.loads(raw)
+    assert code == 503 and body["draining"] is True
+    assert hdrs.get("Retry-After") == str(body["retry_after_s"])
+    # ...and /status says so.
+    code, status = _req_json(base, "/status")
+    assert code == 200 and status.get("draining") is True
+    blocker.end(1)
+    d.queue.unregister("blocker")
+    assert d.wait_drained(timeout=60)
+    # The parked job got NO terminal record: still pending/running,
+    # its journal holding exactly the completed chunk.
+    code, doc = _req_json(base, f"/jobs/{jid}")
+    assert doc["status"] in ("pending", "running")
+    jdir = os.path.join(d.root, "jobs", jid)
+    assert sorted(SurveyJournal(jdir).completed_chunks()) == [0]
+    d.stop()
+    # The restart replays the registry, re-queues the parked job
+    # (resumed-flagged) and its journal finishes the remaining chunks.
+    d2, base2 = daemon(workers=1)
+    doc = _wait_terminal(base2, jid)
+    assert doc["status"] == "done", doc.get("error")
+    assert doc.get("resumed") is True
+    assert sorted(SurveyJournal(jdir).completed_chunks()) \
+        == list(range(len(DMS)))
+
+
+def test_concurrent_fault_attribution_is_job_scoped(daemon, data_files):
+    # Two concurrent jobs, EACH with its own injected heartbeat-fsync
+    # fault: every obs_write_failed incident must land in the journal
+    # of the job whose heartbeat it was — never the sibling's. This is
+    # the RunContext attribution contract under real thread
+    # interleaving (two workers, fair-share alternation).
+    d, base = daemon(workers=2)
+    jids = []
+    for tenant in ("alice", "bob"):
+        spec = _spec(data_files, tenant=tenant)
+        spec["fault_inject"] = "fsync_fail:heartbeat_append"
+        code, doc = _req_json(base, "/jobs", "POST", spec)
+        assert code == 202
+        jids.append(doc["job_id"])
+    docs = [_wait_terminal(base, jid) for jid in jids]
+    # Heartbeats are observability: the faults degrade, never kill.
+    assert all(doc["status"] == "done" for doc in docs)
+    for doc, jid, sibling in ((docs[0], jids[0], jids[1]),
+                              (docs[1], jids[1], jids[0])):
+        errs = [rec["detail"].get("error", "")
+                for rec in SurveyJournal(doc["directory"]).incidents()
+                if rec["incident"] == "obs_write_failed"]
+        assert errs, f"{jid}: no obs_write_failed journaled"
+        # The injected error names the faulted path — which lives in
+        # the job's own directory, so attribution is checkable.
+        assert all(jid in err for err in errs)
+        assert not any(sibling in err for err in errs)
+
+
+def test_device_error_single_fault_retries_to_done(daemon, data_files):
+    d, base = daemon(workers=1)
+    before = get_metrics().counter("device_errors")
+    spec = _spec(data_files[:1])
+    spec["fault_inject"] = "device_error:0"
+    code, doc = _req_json(base, "/jobs", "POST", spec)
+    assert code == 202
+    doc = _wait_terminal(base, doc["job_id"])
+    # One transient XLA runtime failure: the retry path evicts the
+    # resident executables and the re-dispatch completes the job.
+    assert doc["status"] == "done", doc.get("error")
+    assert get_metrics().counter("device_errors") > before
+
+
+def test_persistent_device_error_fails_only_that_job(daemon, data_files):
+    d, base = daemon(workers=2)
+    code, clean = _req_json(base, "/jobs", "POST", _spec(data_files[:1]))
+    assert code == 202
+    spec = _spec(data_files[:1], tenant="victim")
+    spec["fault_inject"] = "device_error:0x9"  # outlasts every retry
+    code, faulted = _req_json(base, "/jobs", "POST", spec)
+    assert code == 202
+    fdoc = _wait_terminal(base, faulted["job_id"])
+    cdoc = _wait_terminal(base, clean["job_id"])
+    # Containment: only the implicated job fails, with the incident in
+    # ITS journal; the sibling and the daemon are untouched.
+    assert cdoc["status"] == "done", cdoc.get("error")
+    assert fdoc["status"] == "failed"
+    fj = SurveyJournal(fdoc["directory"])
+    assert any(rec["incident"] == "device_error" for rec in fj.incidents())
+    cj = SurveyJournal(cdoc["directory"])
+    assert not any(rec["incident"] == "device_error"
+                   for rec in cj.incidents())
+    # The daemon keeps serving after the device error.
+    code, doc = _req_json(base, "/jobs", "POST", _spec(data_files[:1]))
+    assert code == 202
+    assert _wait_terminal(base, doc["job_id"])["status"] == "done"
 
 
 def test_jobs_endpoint_without_daemon():

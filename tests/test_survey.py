@@ -13,7 +13,8 @@ import os
 import numpy as np
 import pytest
 
-from riptide_tpu.survey.faults import FaultAbort, FaultPlan, InjectedFault
+from riptide_tpu.survey.faults import (FaultAbort, FaultPlan,
+                                       InjectedDeviceError, InjectedFault)
 from riptide_tpu.survey.journal import JournalMismatch, SurveyJournal
 from riptide_tpu.survey.metrics import MetricsRegistry, get_metrics
 from riptide_tpu.survey.scheduler import (
@@ -282,6 +283,39 @@ def test_scheduler_exhausted_retries_raise(tmp_path):
     )
     with pytest.raises(InjectedFault):
         sched.run()
+
+
+def test_scheduler_device_error_retries_and_recovers(tmp_path):
+    get_metrics().reset()
+    f1, f2 = _two_trials(tmp_path)
+    journal = SurveyJournal(tmp_path / "j")
+    sched = SurveyScheduler(
+        _searcher(), [[f1], [f2]], journal=journal,
+        retry=_fast_retry(), faults=FaultPlan.parse("device_error:0"),
+    )
+    peaks = sched.run()
+    # One transient XLA runtime failure: classified (not a generic
+    # retry), resident executables evicted, re-fire completes.
+    assert peaks
+    assert get_metrics().counter("device_errors") >= 1
+    assert sorted(journal.completed_chunks()) == [0, 1]
+
+
+def test_scheduler_persistent_device_error_raises_with_incident(tmp_path):
+    get_metrics().reset()
+    f1, _ = _two_trials(tmp_path)
+    journal = SurveyJournal(tmp_path / "j")
+    sched = SurveyScheduler(
+        _searcher(), [[f1]], journal=journal,
+        retry=RetryPolicy(max_retries=1, sleep=lambda s: None),
+        faults=FaultPlan.parse("device_error:0x5"),
+    )
+    with pytest.raises(InjectedDeviceError):
+        sched.run()
+    # Retry exhaustion attributes the failure as a device_error
+    # incident in the run's own journal (its RunContext sink).
+    assert any(rec["incident"] == "device_error"
+               for rec in journal.incidents())
 
 
 def test_scheduler_resume_skips_and_matches(tmp_path):

@@ -26,6 +26,13 @@ Submit with ``rseek --submit http://127.0.0.1:<port>`` or raw HTTP
 (``POST /jobs``); see docs/survey_service.md. On restart the daemon
 replays ``jobs.jsonl`` and resumes every unfinished job from its own
 survey journal.
+
+Shutdown is a graceful drain: SIGTERM/SIGINT (or ``POST /drain``)
+stops admission (503), lets the chunk holding the device turn finish,
+parks every other job at its chunk gate WITHOUT a terminal registry
+record, and exits 0 once the workers have parked (bounded by
+``RIPTIDE_SERVE_DRAIN_TIMEOUT_S``). A restarted rserve re-queues the
+parked jobs (``resumed``) and they continue from their journals.
 """
 import argparse
 import logging
@@ -75,9 +82,21 @@ def main(argv=None):
         signal.signal(sig, lambda *_: stop.set())
     try:
         while not stop.wait(timeout=0.5):
-            pass
+            # POST /drain initiates the same shutdown from the HTTP
+            # side; fall through to the drain wait below.
+            if daemon.draining:
+                break
     finally:
+        # Graceful drain: stop admission, let the running chunk finish,
+        # park queued jobs at the chunk gate (journals resumable, no
+        # terminal registry record), then tear the daemon down.
+        timeout = float(envflags.get("RIPTIDE_SERVE_DRAIN_TIMEOUT_S"))
+        daemon.drain(timeout=timeout)
+        if not daemon.wait_drained(timeout=timeout):
+            print("rserve: drain timed out; exiting with workers "
+                  "still parked", flush=True)
         daemon.stop()
+    print("rserve: drained, exiting", flush=True)
     return 0
 
 

@@ -3,7 +3,7 @@
 serve-demo: end-to-end acceptance of the survey service (PR 16) — the
 warm, multi-tenant rserve daemon proven live on the CPU backend.
 
-Three legs:
+Four legs:
 
 1. **batch controls** — the demo's two input sets run through the
    ordinary in-process :class:`SurveyScheduler`; their ``peaks.csv``
@@ -25,6 +25,12 @@ Three legs:
    ``jobs.jsonl``, re-queues the job (``resumed`` flagged), resumes
    its survey journal and serves a ``peaks.csv`` byte-identical to the
    control — the durability contract of docs/survey_service.md.
+4. **graceful drain (PR 17)** — a fresh rserve subprocess gets SIGTERM
+   while a job is mid-survey (a ``stall`` spec fault holds a chunk
+   open long enough to land the signal deterministically): the daemon
+   must finish the in-flight chunk, park the job WITHOUT a terminal
+   registry record and exit **0**; the restart re-queues it
+   (``resumed``) and serves a byte-identical ``peaks.csv``.
 
 Output directory: /tmp/riptide_serve_demo (or argv[1]). ``make
 serve-demo`` runs this; it is wired into ``make check-full``.
@@ -109,6 +115,25 @@ def _batch_control(files, jdir, csv_path):
     write_peaks_csv(peaks, csv_path)
     with open(csv_path, "rb") as fobj:
         return fobj.read()
+
+
+def _chunk_count(journal_path):
+    from riptide_tpu.utils import fsio
+
+    entries, _ = fsio.scan_jsonl(journal_path)
+    return sum(1 for obj, _status, _off in entries
+               if obj and obj.get("kind") == "chunk")
+
+
+def _fold_registry(root):
+    """``{job_id: state}`` folded straight from a serve root's
+    ``jobs.jsonl`` (for asserting registry state with no daemon up)."""
+    from riptide_tpu.serve.daemon import fold_job_events
+    from riptide_tpu.utils import fsio
+
+    entries, _ = fsio.scan_jsonl(os.path.join(root, "jobs.jsonl"))
+    return fold_job_events([obj for obj, _status, _off in entries
+                            if obj])
 
 
 def _rserve_env(faults=None):
@@ -261,8 +286,54 @@ def main(outdir="/tmp/riptide_serve_demo"):
     print(f"recovery OK: daemon killed mid-job (exit 137), restart "
           f"resumed {jid} to byte-identical peaks.csv")
 
-    print(f"\nserve demo OK: 4 service jobs across 2 daemons")
-    print(f"  serve dirs ->  {serve1}  {serve2}")
+    # -- leg 4: graceful drain (SIGTERM), restart, resume -------------
+    serve3 = os.path.join(outdir, "serve3")
+    proc, base = _start_rserve(serve3)
+    # The stall holds chunk 1's dispatch open for 2.5 s — a wide,
+    # deterministic window to land SIGTERM mid-survey. On the restart
+    # leg it is inert: chunk 1 is already journaled, so the directive
+    # never re-fires even though the spec fault persists in the
+    # registry.
+    spec = _spec(files_a, "alice")
+    spec["fault_inject"] = "stall:1:2.5"
+    code, doc = _req_json(base, "/jobs", "POST", spec)
+    assert code == 202, doc
+    jid = doc["job_id"]
+    jpath = os.path.join(serve3, "jobs", jid, "journal.jsonl")
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        if os.path.exists(jpath) and _chunk_count(jpath) >= 1:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError(f"{jid} never journaled its first chunk")
+    proc.terminate()  # SIGTERM: the graceful-drain path
+    proc.wait(timeout=120)
+    assert proc.returncode == 0, \
+        f"drain leg exited {proc.returncode}, wanted 0 (graceful drain)"
+    st = _fold_registry(serve3).get(jid, {})
+    assert st.get("status") not in ("done", "failed", "cancelled"), \
+        f"drained job ended terminal ({st.get('status')!r}); " \
+        "drain must park it resumable"
+    proc, base = _start_rserve(serve3)
+    try:
+        doc = _wait_terminal(base, jid)
+        assert doc["status"] == "done", doc.get("error")
+        assert doc.get("resumed") is True, doc
+        code, payload = _req(base, f"/jobs/{jid}/peaks")
+        assert code == 200
+        assert payload == control_a, \
+            "drained job's peaks.csv diverged from the batch control"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=60)
+    assert proc.returncode == 0, f"rserve shutdown exited {proc.returncode}"
+    print(f"drain OK: SIGTERM mid-job exited 0 with {jid} parked "
+          "non-terminally; restart resumed it to byte-identical "
+          "peaks.csv")
+
+    print(f"\nserve demo OK: 5 service jobs across 3 daemons")
+    print(f"  serve dirs ->  {serve1}  {serve2}  {serve3}")
     sys.stdout.write(frame)
     return 0
 
