@@ -37,6 +37,7 @@ prove:
 # genuinely bad kernel change — it only blesses layout/shape drift.
 repin:
 	$(PYTHON) tools/update_kernel_digest.py
+	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/update_canary_digest.py
 	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py --update --all
 	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py --all
 

@@ -80,6 +80,9 @@ WRITER_SPECS = (
     ("riptide_tpu/obs/schema.py", "decomposition", "ledger"),
     # The chunk record's predicted-vs-actual peak-HBM block (PR 12).
     ("riptide_tpu/obs/schema.py", "hbm_block", "hbm"),
+    # The chunk record's result-integrity block (PR 18): Ring 1
+    # digests + shadow-probe provenance, merged via `extra=`.
+    ("riptide_tpu/obs/schema.py", "integrity_block", "integrity"),
     # Provenance merged in through `extra=` at the call sites.
     ("riptide_tpu/survey/scheduler.py", "SurveyScheduler._run", "ledger"),
     ("riptide_tpu/parallel/multihost.py", "run_search_multihost",

@@ -147,6 +147,13 @@ def default_rules():
             help="the HBM model's predicted-vs-actual ratio drifted "
                  "beyond the margin: re-fit before trusting seeded "
                  "batching"),
+        AlertRule(
+            "integrity", "integrity_mismatches", 1, op=">=",
+            help="result-integrity mismatch(es) detected: a device "
+                 "returned different bytes for the same chunk, or a "
+                 "replayed chunk no longer matches its journaled "
+                 "digest — audit rreport's integrity section before "
+                 "trusting this archive"),
     ]
 
 

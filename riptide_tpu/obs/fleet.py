@@ -99,6 +99,8 @@ def snapshot(process_index, status=None, metrics=None, timings=None,
             "chunks_retried": int(metrics.counter("chunks_retried")),
             "chunks_timed_out": int(metrics.counter("chunks_timed_out")),
             "oom_bisections": int(metrics.counter("oom_bisections")),
+            "integrity_mismatches":
+                int(metrics.counter("integrity_mismatches")),
         }
     return {
         "kind": "fleet",

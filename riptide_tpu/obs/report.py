@@ -516,7 +516,15 @@ def watch_snapshot(state, heartbeats=None, now=None, window=WATCH_WINDOW):
     * ``obs_write_failures`` — count of ``obs_write_failed`` incidents
       so far (a monotone series the growth rule differentiates);
     * ``hbm_ratio_median`` — actual/predicted peak-HBM ratio over the
-      windowed chunks (model drift signal).
+      windowed chunks (model drift signal);
+    * ``integrity_mismatches`` — count of result-integrity divergence
+      incidents (``result_mismatch``/``canary_failed``) so far: the
+      journal-derived twin of the scheduler's counter, so the
+      ``integrity`` alert rule fires on identical evidence in-process
+      and from rwatch;
+    * ``integrity_probed`` — how many of the windowed chunks' records
+      carry a shadow-verified ``integrity`` block (coverage signal;
+      pre-PR-18 journals simply report 0).
     """
     now = time.time() if now is None else now
     header = state.get("header") or {}
@@ -525,6 +533,7 @@ def watch_snapshot(state, heartbeats=None, now=None, window=WATCH_WINDOW):
     parked = state.get("parked") or {}
     recent = [chunks[cid] for cid in sorted(chunks)][-int(window):]
     walls, bounds, hbm_ratios = [], [], []
+    integrity_probed = 0
     for rec in recent:
         t = rec.get("timings") or {}
         w = float(t.get("chunk_s", 0.0))
@@ -534,6 +543,8 @@ def watch_snapshot(state, heartbeats=None, now=None, window=WATCH_WINDOW):
         h = rec.get("hbm") or {}
         if h.get("ratio") is not None:
             hbm_ratios.append(float(h["ratio"]))
+        if (rec.get("integrity") or {}).get("probe"):
+            integrity_probed += 1
     consecutive_tunnel = 0
     for b in reversed(bounds):
         if b != "tunnel":
@@ -564,6 +575,11 @@ def watch_snapshot(state, heartbeats=None, now=None, window=WATCH_WINDOW):
             if inc.get("incident") == "obs_write_failed"),
         "hbm_ratio_median": (round(_median(hbm_ratios), 4)
                              if hbm_ratios else None),
+        "integrity_mismatches": sum(
+            1 for inc in state.get("incidents") or ()
+            if inc.get("incident") in ("result_mismatch",
+                                       "canary_failed")),
+        "integrity_probed": integrity_probed,
     }
 
 
@@ -751,6 +767,50 @@ def hbm_stats(chunks):
     return out
 
 
+def integrity_stats(chunks, incidents=()):
+    """Result-integrity coverage and verdict over the journaled
+    chunks' ``integrity`` blocks (obs.schema.integrity_block) and the
+    incident stream: how much of the archive was digested/shadow-
+    verified, every detected divergence, and the device verdict —
+    ``suspect`` once a quarantine or canary failure is on record,
+    ``ok`` while checks ran clean, ``unchecked`` for off-mode and
+    pre-0.17 journals (which contribute nothing, by design). The
+    per-chunk ``device_error_retries`` attribution (PR 18's companion
+    fix to the monotone run-wide counter) is surfaced here too."""
+    digested = probed = voted = 0
+    mode = None
+    retries = {}
+    for cid, rec in chunks.items():
+        blk = rec.get("integrity") or {}
+        if blk.get("result") or blk.get("peaks"):
+            digested += 1
+            mode = blk.get("mode") or mode
+        if blk.get("probe"):
+            probed += 1
+        if blk.get("votes"):
+            voted += 1
+        if rec.get("device_error_retries"):
+            retries[cid] = int(rec["device_error_retries"])
+    kinds = [inc.get("incident") for inc in incidents]
+    quarantines = kinds.count("integrity_quarantine")
+    canary_failures = kinds.count("canary_failed")
+    out = {
+        "chunks_digested": digested,
+        "chunks_probed": probed,
+        "chunks_voted": voted,
+        "mismatch_incidents": kinds.count("result_mismatch"),
+        "quarantines": quarantines,
+        "canary_failures": canary_failures,
+        "device_verdict": ("suspect" if quarantines or canary_failures
+                           else "ok" if digested else "unchecked"),
+    }
+    if mode:
+        out["mode"] = mode
+    if retries:
+        out["device_error_retries"] = retries
+    return out
+
+
 # ------------------------------------------------------------ the report
 
 def build_report(journal_dir, trace_path=None, prom_path=None):
@@ -776,6 +836,7 @@ def build_report(journal_dir, trace_path=None, prom_path=None):
         "stragglers": stragglers(chunks),
         "tunnel": tunnel_stats(chunks),
         "hbm": hbm_stats(chunks),
+        "integrity": integrity_stats(chunks, j["incidents"]),
         "incidents": j["incidents"],
         "alerts": j.get("alerts", []),
         "metrics": j["metrics"],
@@ -851,6 +912,28 @@ def render_text(report):
             line += (f", actual/predicted median "
                      f"{hbm['ratio_median']}")
         add(line)
+    integ = report.get("integrity") or {}
+    if (integ.get("chunks_digested") or integ.get("mismatch_incidents")
+            or integ.get("quarantines") or integ.get("canary_failures")
+            or integ.get("device_error_retries")):
+        add("")
+        line = (f"integrity: {integ.get('chunks_digested', 0)} chunk(s)"
+                f" digested")
+        if integ.get("mode"):
+            line += f" (mode {integ['mode']})"
+        line += (f", {integ.get('chunks_probed', 0)} shadow-verified, "
+                 f"{integ.get('chunks_voted', 0)} vote-resolved; "
+                 f"{integ.get('mismatch_incidents', 0)} mismatch "
+                 f"incident(s), {integ.get('quarantines', 0)} "
+                 f"quarantine(s), {integ.get('canary_failures', 0)} "
+                 f"canary failure(s)")
+        add(line)
+        add(f"  device verdict: {integ.get('device_verdict')}")
+        if integ.get("device_error_retries"):
+            pairs = ", ".join(
+                f"chunk {cid}: {n}" for cid, n in
+                sorted(integ["device_error_retries"].items()))
+            add(f"  device-error retries attributed: {pairs}")
     if report["stragglers"]:
         add("")
         add("stragglers (> {:.1f}x median chunk_s):".format(

@@ -39,7 +39,7 @@ treat an alias's presence as deprecation notice for the old name).
 __all__ = [
     "TIMING_VERSION", "PHASES", "DECOMPOSITION_KEYS", "CHUNK_TIMING_KEYS",
     "LEGACY_ALIASES", "decomposition", "chunk_timing", "classify_bound",
-    "hbm_block",
+    "hbm_block", "integrity_block",
 ]
 
 TIMING_VERSION = 1
@@ -132,4 +132,34 @@ def hbm_block(predicted_bytes, actual_bytes, budget_bytes):
         out["actual_bytes"] = int(actual_bytes)
         if predicted_bytes > 0:
             out["ratio"] = round(actual_bytes / predicted_bytes, 4)
+    return out
+
+
+def integrity_block(mode, result, peaks, path=None, probe=False,
+                    votes=None):
+    """One chunk's journal ``integrity`` block, sibling of ``timings``/
+    ``dq``/``hbm``: the result-integrity layer's Ring 1 digests
+    (:mod:`riptide_tpu.survey.integrity`). ``result`` is the sha256
+    fold over the raw collected device buffers (dtype + shape + bytes,
+    in collect order — comparable only against another dispatch of the
+    SAME chunk in the same process); ``peaks`` the digest over the
+    journal's canonical peak-row serialisation, recomputable from
+    replayed peaks so a resume can re-verify the record without the
+    device. ``path`` labels the collect path (``batch``/``sharded``);
+    ``probe`` marks a chunk whose record survived a Ring 2 shadow
+    comparison, and ``votes`` (present only after a re-arbitration)
+    the three short digests the majority vote saw."""
+    out = {
+        "v": 1,
+        "algo": "sha256",
+        "mode": str(mode),
+        "result": result,
+        "peaks": peaks,
+    }
+    if path:
+        out["path"] = str(path)
+    if probe:
+        out["probe"] = True
+    if votes:
+        out["votes"] = list(votes)
     return out

@@ -294,8 +294,10 @@ def collect_search_sharded(handle, dms):
     finish on host. Returns (peaks_per_trial, polycos_per_trial) trimmed
     to the original (unpadded) D trials."""
     from ..search.peaks_device import collect_peaks
+    from ..survey.integrity import set_collect_path
 
     pp, peaks_handle, D = handle
+    set_collect_path("sharded")
     Dpad = peaks_handle[1].shape[0]
     dms_full = np.concatenate(
         [np.asarray(dms, float), np.zeros(Dpad - len(dms))]

@@ -1149,8 +1149,10 @@ def collect_search_batch(handle, dms):
     """Sync one queued batch: one device->host pull + host clustering.
     Returns (peaks_per_trial, polycos_per_trial)."""
     from .peaks_device import collect_peaks
+    from ..survey.integrity import set_collect_path
 
     pp, peaks_handle = handle
+    set_collect_path("batch")
     # A sanctioned sync point: the span and the device_s timer cover
     # the same blocking device wait + single result pull.
     with get_metrics().timer("device_s"), span("device"):

@@ -38,6 +38,7 @@ from functools import partial
 
 from ..clustering import cluster1d
 from ..obs.trace import span
+from ..survey.integrity import fold_result
 from ..utils.exec_cache import cached_jit
 from ..peak_detection import Peak, fit_threshold
 
@@ -302,6 +303,10 @@ def collect_peaks(peak_plan, handle, dms):
     buf_dev, snr_dev = handle
     D = snr_dev.shape[0]
     buf = np.asarray(buf_dev)                              # the one pull
+    # Integrity Ring 1: fold the raw collected bytes into the dispatch
+    # attempt's digest, host-side AFTER the pull (a no-op returning
+    # ``buf`` untouched when no fold context is active).
+    buf = fold_result(buf)
     handle[0] = buf_dev = None
     stats, cnt, ids, vals = peak_plan._unpack(buf, D)
     # The S/N cube is only needed again for the (pathological) overflow
@@ -358,6 +363,7 @@ def collect_peaks(peak_plan, handle, dms):
         gvals = np.asarray(peak_plan._gather_blocks(
             snr_dev, jnp.asarray(padded)
         ))[: len(flat_ids)]
+        gvals = fold_result(gvals)
         handle[1] = snr_dev = None
         for row, (d, iw, b) in zip(gvals, sel):
             add(d, iw, b, row)

@@ -493,6 +493,14 @@ class ServeDaemon:
             except (TypeError, ValueError):
                 return 400, {"error": "'deadline_s' must be a positive "
                                       "number of seconds"}
+        if spec.get("integrity") is not None:
+            # Validate at admission (lazy import — jax-free): a typo'd
+            # integrity spec must 400 here, not fail the job at run.
+            from ..survey.integrity import IntegrityConfig
+            try:
+                IntegrityConfig.from_spec(spec["integrity"])
+            except ValueError as err:
+                return 400, {"error": str(err)}
         with self._lock:
             resident = sum(1 for st in self._jobs.values()
                            if st.get("status") in ("pending", "running"))
@@ -733,9 +741,19 @@ class ServeDaemon:
                 st.update(status="failed", finished_utc=rec["utc"],
                           error=str(err))
         except Exception as err:
+            from ..survey.integrity import IntegrityQuarantineError
             from ..survey.liveness import is_device_error
 
-            if is_device_error(err):
+            if isinstance(err, IntegrityQuarantineError):
+                # PR 17 containment, integrity edition: serve-mode
+                # quarantine policy is "fail", so only THIS job dies —
+                # the scheduler already journaled the result_mismatch /
+                # integrity_quarantine incidents (with the canary
+                # verdict) into the job's own journal. An expected,
+                # classified terminal outcome logs clean, no traceback.
+                log.error("serve: %s failed integrity quarantine: %s",
+                          jid, err)
+            elif is_device_error(err):
                 # Classified, contained failure: the scheduler already
                 # journaled the device_error incident and evicted the
                 # resident executables on each retry — an expected
@@ -789,9 +807,16 @@ class ServeDaemon:
         fault_spec = spec.get("fault_inject") \
             or envflags.get("RIPTIDE_FAULT_INJECT")
         faults = FaultPlan.parse(fault_spec) if fault_spec else None
+        # Result-integrity policy rides the job the same way faults do
+        # (per-job spec field, environment fallback), with the serve
+        # quarantine policy: "fail" — a suspect verdict fails only the
+        # implicated job instead of parking the whole process's queue.
+        from ..survey.integrity import IntegrityConfig
+        integrity = IntegrityConfig.from_spec(spec.get("integrity"),
+                                              policy="fail")
         scheduler = SurveyScheduler(
             searcher, chunks, journal=SurveyJournal(jobdir),
-            resume=True, faults=faults,
+            resume=True, faults=faults, integrity=integrity,
             retry=RetryPolicy(max_retries=2, base_s=0.01, cap_s=0.05),
             chunk_gate=gate)
         with self._lock:

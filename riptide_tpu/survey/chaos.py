@@ -154,6 +154,30 @@ def builtin_schedules():
         {"name": "serve-device-error", "serve": True,
          "legs": [{"faults": "", "serve_device_error": True}],
          "incidents": []},
+        # Result integrity (PR 18): a TRANSIENT in-flight bitflip on
+        # chunk 1's primary dispatch. The shadow probe detects the
+        # digest divergence (`result_mismatch` incident) and the
+        # third-dispatch vote out-votes the corrupted primary 2:1 —
+        # the run completes in one leg, no quarantine, and peaks.csv
+        # is byte-identical to the control run's.
+        {"name": "bitflip-detect-revote",
+         "legs": [{"faults": "bitflip:1", "integrity": "probe",
+                   "probe_every": 1}],
+         "incidents": ["result_mismatch"]},
+        # PERSISTENT corruption: all three of chunk 1's dispatches flip
+        # (a different byte each — a device that cannot agree with
+        # itself), so the vote cannot resolve. The device quarantines:
+        # chunk 1 parks, the latch parks chunk 2 behind it, and the leg
+        # exits 0 degraded. The clean resume leg replays chunk 0
+        # (re-verifying its journaled digest) and re-dispatches the
+        # parked chunks to a byte-identical peaks.csv.
+        {"name": "bitflip-quarantine-resume",
+         "legs": [{"faults": "bitflip:1x3", "integrity": "probe",
+                   "probe_every": 1},
+                  {"faults": "", "resume": True, "integrity": "probe",
+                   "probe_every": 1}],
+         "incidents": ["result_mismatch", "integrity_quarantine",
+                       "chunk_parked"]},
     ]
 
 
@@ -229,6 +253,8 @@ def _run_leg(schedule, i, leg, paths, python, timeout_s):
         "serve_root": paths.get("serve_root"),
         "serve_drain": bool(leg.get("serve_drain", False)),
         "serve_device_error": bool(leg.get("serve_device_error", False)),
+        "integrity": leg.get("integrity"),
+        "probe_every": leg.get("probe_every"),
     }
     cfg_path = os.path.join(paths["sdir"], f"leg{i}.json")
     with open(cfg_path, "w") as fobj:
@@ -236,7 +262,8 @@ def _run_leg(schedule, i, leg, paths, python, timeout_s):
     env = dict(os.environ)
     env.pop("PYTHONPATH", None)
     for name in ("RIPTIDE_FAULT_INJECT", "RIPTIDE_TRACE",
-                 "RIPTIDE_PROM_TEXTFILE", "RIPTIDE_PROM_PORT"):
+                 "RIPTIDE_PROM_TEXTFILE", "RIPTIDE_PROM_PORT",
+                 "RIPTIDE_INTEGRITY", "RIPTIDE_INTEGRITY_PROBE_EVERY"):
         env.pop(name, None)
     env["JAX_PLATFORMS"] = "cpu"
     env["RIPTIDE_LEDGER"] = paths["ledger"]
@@ -248,6 +275,13 @@ def _run_leg(schedule, i, leg, paths, python, timeout_s):
     if leg.get("prom"):
         env["RIPTIDE_PROM_TEXTFILE"] = os.path.join(paths["sdir"],
                                                     "metrics.prom")
+    if cfg["integrity"]:
+        # The leg process's scheduler resolves its integrity config
+        # from the environment (chaos legs construct the scheduler
+        # without an explicit integrity kwarg).
+        env["RIPTIDE_INTEGRITY"] = str(cfg["integrity"])
+        if cfg["probe_every"]:
+            env["RIPTIDE_INTEGRITY_PROBE_EVERY"] = str(cfg["probe_every"])
     if cfg["serve"] and cfg["faults"]:
         # Serve legs inject through the daemon's environment (the
         # scheduler installs its own storage-fault hook per run, so a

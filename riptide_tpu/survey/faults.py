@@ -50,6 +50,16 @@ Spec grammar: comma-separated directives, each
   re-fires the chunk through the ordinary retry path; ``x9`` (more
   firings than retries) exhausts the retries and fails the run/job
   with a ``device_error`` incident.
+* ``bitflip:1``     silently corrupt chunk 1's collected result buffer
+  in-flight (one XOR-flipped byte in the first device buffer the
+  dispatch attempt collects) — the device "returns" plausible but
+  wrong bytes and NOTHING raises, which is exactly the failure the
+  result-integrity layer (:mod:`riptide_tpu.survey.integrity`,
+  ``RIPTIDE_INTEGRITY=probe``) exists to detect. Each consumed hit
+  flips a DIFFERENT byte, so ``bitflip:1`` corrupts only the primary
+  dispatch (the shadow probe detects it and the third-dispatch vote
+  out-votes it) while ``bitflip:1x3`` corrupts all three dispatches
+  distinctly (the device cannot agree with itself → quarantine).
 
 **Storage faults** target a persistence *site* (a name from
 :data:`riptide_tpu.utils.fsio.SITES`) instead of a chunk id, and fire
@@ -93,7 +103,7 @@ __all__ = ["FaultPlan", "FaultAbort", "InjectedDeviceError",
 log = logging.getLogger("riptide_tpu.survey.faults")
 
 _KINDS = ("raise", "stall", "corrupt", "abort", "nan_inject", "oom",
-          "hang", "straggle", "peer_loss", "device_error",
+          "hang", "straggle", "peer_loss", "device_error", "bitflip",
           "torn_write", "enospc", "fsync_fail", "kill_at",
           "cache_corrupt")
 
@@ -286,6 +296,28 @@ class FaultPlan:
             log.warning("fault injection: peer loss at chunk %d's gather",
                         chunk_id)
             raise InjectedPeerLoss(chunk_id)
+
+    def bitflip_arm(self, chunk_id):
+        """Called once per dispatch attempt: consume one ``bitflip``
+        hit for this chunk and return its 0-based hit index (the byte
+        offset the integrity layer's fold will XOR-flip in the first
+        collected buffer), or None with no hit armed. Distinct offsets
+        per hit keep repeated corruption from ever producing two
+        AGREEING wrong digests — a persistent fault must look like a
+        device that cannot agree with itself, not like consensus."""
+        with self._lock:
+            for d in self._directives:
+                if d["kind"] == "bitflip" and d.get("chunk") == chunk_id \
+                        and d["remaining"] > 0:
+                    d["remaining"] -= 1
+                    d["fired"] = d.get("fired", 0) + 1
+                    hit = d["fired"] - 1
+                    break
+            else:
+                return None
+        log.warning("fault injection: arming result bitflip (hit %d) on "
+                    "chunk %d's dispatch", hit, chunk_id)
+        return hit
 
     def corrupt_wire(self, chunk_id, items):
         """Called once per chunk after host preparation: flips the first

@@ -68,6 +68,9 @@ INCIDENT_KINDS = (
     "job_cancelled",      # serve: job cancelled at a chunk boundary
     "job_timeout",        # serve: per-job deadline_s exceeded at the gate
     "device_error",       # scheduler: non-OOM device runtime error exhausted
+    "result_mismatch",    # integrity: result digests diverged (shadow/replay)
+    "integrity_quarantine",  # integrity: device marked suspect, chunks parked
+    "canary_failed",      # integrity: golden canary missed its pinned digest
 )
 
 _lock = threading.Lock()

@@ -37,6 +37,9 @@ contains whatever was recorded):
 ``chunks_parked``         counter: chunks set aside by the open breaker
 ``peer_losses``           counter: collectives degraded to local-only mode
 ``device_errors``         counter: non-OOM XLA runtime errors hit in dispatch
+``integrity_checks``      counter: result-integrity digest comparisons run
+``integrity_mismatches``  counter: digest comparisons that DISAGREED
+``shadow_probes``         counter: extra shadow/arbitration dispatches fired
 ``incidents``             counter: structured incident records emitted
 ``heartbeat_age_s``       gauge: age of the stalest peer heartbeat
 ========================  ====================================================
@@ -203,7 +206,8 @@ class MetricsRegistry:
         # Survey-health counters keep a stable schema: always present,
         # zero when the corresponding machinery never fired.
         for name in ("chunks_timed_out", "breaker_opens", "chunks_parked",
-                     "peer_losses", "device_errors", "incidents"):
+                     "peer_losses", "device_errors", "integrity_checks",
+                     "integrity_mismatches", "shadow_probes", "incidents"):
             out.setdefault(name, 0)
         return out
 

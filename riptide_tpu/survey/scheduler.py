@@ -52,11 +52,13 @@ from ..obs import fleet, prom
 from ..obs import report as obs_report
 from ..obs.alerts import AlertEngine, install_engine, rules_from_spec
 from ..obs.chrome import export_run_trace
-from ..obs.schema import chunk_timing
+from ..obs.schema import chunk_timing, integrity_block
 from ..obs.trace import span
 from ..utils import envflags, fsio, runctx
 from . import incidents
 from .faults import FaultAbort, FaultPlan
+from .integrity import (IntegrityConfig, IntegrityManager,
+                        IntegrityQuarantineError, peaks_digest)
 from .liveness import is_device_error, is_timeout_error
 from .metrics import get_metrics
 
@@ -347,13 +349,20 @@ class SurveyScheduler:
         resumable. ``end`` is called when the chunk's turn is over
         (success, park, or failure alike). None (the default) keeps
         batch behaviour: no gating, zero overhead.
+    integrity : IntegrityConfig, IntegrityManager or None
+        Result-integrity policy (:mod:`riptide_tpu.survey.integrity`):
+        per-chunk result digests, shadow recompute probes and the
+        suspect-device quarantine latch. None (the default) builds the
+        config from ``RIPTIDE_INTEGRITY`` / ``RIPTIDE_INTEGRITY_PROBE_
+        EVERY``; an ``off``-mode config resolves to ``self.integrity =
+        None`` so the fast path carries no integrity state at all.
     """
 
     def __init__(self, searcher, chunks, journal=None, resume=False,
                  retry=None, faults=None, survey_id=None, metrics=None,
                  watchdog=None, breaker=None, monitor=None,
                  process_index=0, fleet_dir=None, alerts=None,
-                 chunk_gate=None):
+                 chunk_gate=None, integrity=None):
         self.searcher = searcher
         self.chunks = [list(c) for c in chunks]
         self.journal = journal
@@ -370,6 +379,12 @@ class SurveyScheduler:
         self.fleet_dir = fleet_dir
         self.alerts = alerts
         self.chunk_gate = chunk_gate
+        if integrity is None:
+            integrity = IntegrityConfig.from_env()
+        if isinstance(integrity, IntegrityConfig):
+            integrity = (IntegrityManager(integrity, metrics=self.metrics)
+                         if integrity.enabled else None)
+        self.integrity = integrity
         if survey_id is None:
             survey_id = survey_identity([f for c in self.chunks for f in c])
         self.survey_id = survey_id
@@ -428,14 +443,20 @@ class SurveyScheduler:
         An attempt the watchdog already abandoned aborts at the
         deadline check instead of shipping real device work.
 
-        Returns ``(peaks, parts)`` where ``parts`` holds the attempt's
-        serial phase seconds (ship/queue/collect wall time measured
-        here; device seconds and wire bytes read as deltas of the
-        engine's own metrics, so the scheduler never re-times what the
-        engine already records). The chunk-tagged spans around each
-        phase are what the engine-level prep/wire/dispatch/device spans
-        nest under — span attribute inheritance is how they pick up the
-        chunk id."""
+        Returns ``(peaks, parts, rinfo)`` where ``parts`` holds the
+        attempt's serial phase seconds (ship/queue/collect wall time
+        measured here; device seconds and wire bytes read as deltas of
+        the engine's own metrics, so the scheduler never re-times what
+        the engine already records) and ``rinfo`` is the attempt's
+        result-integrity fold (``{"result": hex, "nbuf": n, "path":
+        str}``; None while integrity is off). The fold context is
+        installed on THIS thread for the attempt's duration: with a
+        watchdog, that is the sacrificial attempt thread — so an
+        abandoned attempt still blocked in collect folds into its own
+        dead accumulator and can never pollute a newer attempt's
+        digest. The chunk-tagged spans around each phase are what the
+        engine-level prep/wire/dispatch/device spans nest under — span
+        attribute inheritance is how they pick up the chunk id."""
         self.faults.in_flight(chunk_id)
         if deadline is not None:
             deadline.check()
@@ -447,16 +468,25 @@ class SurveyScheduler:
         m = self.metrics
         dev0 = m.timer_total("device_s")
         wb0 = m.counter("wire_bytes")
-        t0 = time.perf_counter()
-        with span("ship", chunk=chunk_id):
-            shipped = self.searcher._ship_chunk(items)
-        t1 = time.perf_counter()
-        with span("queue", chunk=chunk_id):
-            queued = self.searcher._queue_chunk(shipped)
-        t2 = time.perf_counter()
-        with span("collect", chunk=chunk_id):
-            peaks = self.searcher._collect_chunk(queued)
-        t3 = time.perf_counter()
+        acc = None
+        if self.integrity is not None:
+            acc = self.integrity.begin_fold(
+                chunk_id, corrupt_hit=self.faults.bitflip_arm(chunk_id))
+        rinfo = None
+        try:
+            t0 = time.perf_counter()
+            with span("ship", chunk=chunk_id):
+                shipped = self.searcher._ship_chunk(items)
+            t1 = time.perf_counter()
+            with span("queue", chunk=chunk_id):
+                queued = self.searcher._queue_chunk(shipped)
+            t2 = time.perf_counter()
+            with span("collect", chunk=chunk_id):
+                peaks = self.searcher._collect_chunk(queued)
+            t3 = time.perf_counter()
+        finally:
+            if acc is not None:
+                rinfo = self.integrity.finish_fold(acc)
         collect_s = t3 - t2
         # The device wait happens INSIDE collect, so its delta can
         # never legitimately exceed collect_s; clamping bounds the
@@ -471,15 +501,22 @@ class SurveyScheduler:
             "device_s": min(m.timer_total("device_s") - dev0, collect_s),
             "wire_bytes": int(m.counter("wire_bytes") - wb0),
         }
-        return peaks, parts
+        return peaks, parts, rinfo
 
     def _dispatch_with_retry(self, chunk_id, tslist, items, digest):
         """One chunk's device dispatch under :func:`run_with_retry`,
         with a recovery hook that re-prepares the chunk from the
         retained host data when the prepared buffer was corrupted.
-        Returns (peaks, parts, attempts, digest) — ``parts`` is the
-        phase decomposition of the SUCCESSFUL attempt (failed attempts'
-        time lands in the chunk's ``host_s`` remainder)."""
+        Returns (peaks, parts, attempts, digest, rinfo) — ``parts`` is
+        the phase decomposition of the SUCCESSFUL attempt (failed
+        attempts' time lands in the chunk's ``host_s`` remainder) and
+        ``rinfo`` the accepted attempt's integrity fold (None while
+        integrity is off). When the chunk is shadow-probe due, the
+        probe/vote arbitration runs AFTER the retry loop succeeds (see
+        :meth:`_probe_vote`) — a shadow that disagrees persistently
+        raises :class:`IntegrityQuarantineError` (``retryable=False``,
+        so the retry loop can never "retry" a suspect device back to
+        trusted)."""
         state = {"items": items, "digest": digest}
 
         def work():
@@ -514,11 +551,66 @@ class SurveyScheduler:
                     state["items"] = self.searcher._prepare_chunk(tslist)
                 state["digest"] = _wire_digest(state["items"])
 
-        (peaks, parts), attempts = run_with_retry(
+        (peaks, parts, rinfo), attempts = run_with_retry(
             work, chunk_id, self.retry, self.faults, self.metrics,
             on_retry=recover,
         )
-        return peaks, parts, attempts, state["digest"]
+        if self.integrity is not None:
+            self.metrics.add("integrity_checks")
+            if self.integrity.probe_due(chunk_id):
+                peaks, parts, rinfo = self._probe_vote(
+                    chunk_id, state, peaks, parts, rinfo)
+        return peaks, parts, attempts, state["digest"], rinfo
+
+    def _probe_vote(self, chunk_id, state, peaks, parts, rinfo):
+        """Ring 2: shadow-recompute one probe-due chunk through the
+        SAME already-compiled executables and compare result digests
+        bit-exactly. Agreement keeps the primary. Disagreement emits a
+        ``result_mismatch`` incident and a bounded re-arbitration: one
+        third dispatch votes, the majority pair's peaks are accepted
+        (votes journaled in the integrity block), and three distinct
+        digests — a device that cannot agree with itself — raise
+        :class:`IntegrityQuarantineError`."""
+        m = self.metrics
+
+        def shadow():
+            m.add("shadow_probes")
+            m.add("integrity_checks")
+            with span("shadow_probe", chunk=chunk_id):
+                return self._dispatch_once(chunk_id, state["items"],
+                                           state["digest"])
+
+        d1 = (rinfo or {}).get("result")
+        peaks2, parts2, rinfo2 = shadow()
+        d2 = (rinfo2 or {}).get("result")
+        if d1 == d2:
+            rinfo["probe"] = True
+            return peaks, parts, rinfo
+        m.add("integrity_mismatches")
+        incidents.emit("result_mismatch", chunk_id=chunk_id,
+                       primary=(d1 or "")[:12], shadow=(d2 or "")[:12])
+        log.error(
+            "chunk %d: shadow recompute disagrees with primary dispatch "
+            "(%s != %s); arbitrating with a third dispatch", chunk_id,
+            (d1 or "")[:12], (d2 or "")[:12])
+        peaks3, parts3, rinfo3 = shadow()
+        d3 = (rinfo3 or {}).get("result")
+        votes = [(d or "")[:12] for d in (d1, d2, d3)]
+        if d3 == d2:
+            # The primary was the flip: the shadow pair out-votes it.
+            log.warning("chunk %d: vote resolved — primary dispatch "
+                        "out-voted 2:1 (transient corruption)", chunk_id)
+            rinfo3["probe"] = True
+            rinfo3["votes"] = votes
+            return peaks3, parts3, rinfo3
+        if d3 == d1:
+            # The shadow was the flip: the primary stands.
+            log.warning("chunk %d: vote resolved — shadow dispatch "
+                        "out-voted 2:1 (transient corruption)", chunk_id)
+            rinfo["probe"] = True
+            rinfo["votes"] = votes
+            return peaks, parts, rinfo
+        raise IntegrityQuarantineError(chunk_id, (d1, d2, d3))
 
     # -- parking ------------------------------------------------------------
 
@@ -781,6 +873,12 @@ class SurveyScheduler:
 
     def _run(self):
         t_run0 = time.perf_counter()
+        # Ring 3 warmup gate (strict mode only): the golden canary must
+        # reproduce its pinned digest BEFORE any tenant work — a raise
+        # here aborts the run with a ``canary_failed`` incident already
+        # journaled (the sink was installed by run()).
+        if self.integrity is not None:
+            self.integrity.startup_canary()
         done = {}
         if self.journal is not None:
             self.journal.write_header(self.survey_id, len(self.chunks))
@@ -795,6 +893,13 @@ class SurveyScheduler:
                                     expect)
                         continue
                     done[cid] = peaks
+                    # Ring 1 resume verification: a replayed chunk that
+                    # no longer reproduces its journaled peaks digest is
+                    # a detected ``result_mismatch`` incident (records
+                    # without an integrity block — pre-PR-18 journals —
+                    # skip silently).
+                    if self.integrity is not None:
+                        self.integrity.verify_replay(cid, rec, peaks)
                     # Retained for the ledger: a fully-replayed run
                     # still owes its row (see end of _run).
                     if rec.get("timings"):
@@ -846,6 +951,17 @@ class SurveyScheduler:
                     # journal is always left resumable.
                     self.chunk_gate.begin(cid)
                 try:
+                    if self.integrity is not None \
+                            and self.integrity.quarantined:
+                        # The quarantine latch: once a device is marked
+                        # suspect, no further chunk may trust it — park
+                        # everything remaining (a later resume on a
+                        # healthy process re-dispatches them).
+                        self._park(cid, "integrity quarantine: device "
+                                        "marked suspect")
+                        self._fleet_safe()
+                        self._alerts_safe()
+                        continue
                     if self.breaker is not None \
                             and not self.breaker.allow():
                         self._park(cid, f"circuit {self.breaker.state}")
@@ -854,13 +970,32 @@ class SurveyScheduler:
                         continue
                     self._in_flight = cid
                     t0 = time.perf_counter()
+                    de0 = self.metrics.counter("device_errors")
                     self.faults.corrupt_wire(cid, items)
                     try:
-                        peaks, parts, attempts, digest = \
+                        peaks, parts, attempts, digest, rinfo = \
                             self._dispatch_with_retry(cid, tslist, items,
                                                       digest)
                     except (KeyboardInterrupt, SystemExit, FaultAbort):
                         raise
+                    except IntegrityQuarantineError as err:
+                        # Three dispatches, three answers: the device is
+                        # suspect. The latch parks every remaining chunk
+                        # in batch mode ("park"); serve mode ("fail")
+                        # re-raises so only THIS job fails — PR 17
+                        # containment — while sibling jobs keep their
+                        # devices... and their own probes.
+                        verdict = self.integrity.quarantine(
+                            cid, err.digests)
+                        log.error(
+                            "chunk %d: device quarantined (golden canary "
+                            "verdict: %s): %s", cid, verdict, err)
+                        if self.integrity.config.policy == "fail":
+                            raise
+                        self._park(cid, f"integrity quarantine: {err}")
+                        self._fleet_safe()
+                        self._alerts_safe()
+                        continue
                     except Exception as err:
                         if is_device_error(err):
                             # The retries (each of which evicted the
@@ -903,6 +1038,31 @@ class SurveyScheduler:
                         hbm = {}
                         if hasattr(self.searcher, "chunk_hbm_block"):
                             hbm = self.searcher.chunk_hbm_block(items) or {}
+                        # Per-chunk attribution extras: the chunk's
+                        # integrity block (Ring 1 digests + probe/vote
+                        # provenance) and how many device-error retries
+                        # THIS chunk burned (the run-wide counter is
+                        # monotone, so rreport could otherwise only
+                        # report totals). Falsy values are dropped so
+                        # off-mode records stay byte-identical to
+                        # pre-PR-18 ones.
+                        iblk = None
+                        if rinfo is not None:
+                            iblk = integrity_block(
+                                mode=self.integrity.config.mode,
+                                result=rinfo.get("result"),
+                                peaks=peaks_digest(peaks),
+                                path=rinfo.get("path"),
+                                probe=bool(rinfo.get("probe")),
+                                votes=rinfo.get("votes"),
+                            )
+                        extra = {
+                            "integrity": iblk,
+                            "device_error_retries":
+                                int(self.metrics.counter("device_errors")
+                                    - de0),
+                        }
+                        extra = {k: v for k, v in extra.items() if v}
                         with span("journal", chunk=cid):
                             self.journal.record_chunk(
                                 cid, self.chunks[cid],
@@ -910,7 +1070,7 @@ class SurveyScheduler:
                                  for ts in tslist],
                                 peaks, wire_digest=digest,
                                 timings=timing, attempts=attempts, dq=dq,
-                                hbm=hbm,
+                                hbm=hbm, extra=extra or None,
                             )
                     # Per-chunk fleet publication + live alert evaluation
                     # (both no-ops while their flags are off, both

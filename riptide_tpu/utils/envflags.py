@@ -267,7 +267,8 @@ _ALL = [
         "Alert rule spec for the in-scheduler engine: comma-separated "
         "`name[:limit[:for_count]]` entries naming builtin rules "
         "(tunnel_bound, heartbeat_stale, parked_chunks, "
-        "straggler_ratio, obs_write_errors, hbm_drift), or `default` "
+        "straggler_ratio, obs_write_errors, hbm_drift, integrity), or "
+        "`default` "
         "for the full catalog with stock thresholds. Unset = the full "
         "catalog. Unknown names fail the run at start (a typo'd rule "
         "must not silently never fire).",
@@ -365,6 +366,34 @@ _ALL = [
         "gate before rserve exits anyway. Parked jobs keep no terminal "
         "registry record, so a restart re-queues them (`resumed`).",
         since="PR 17 (0.16.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_INTEGRITY", "choice", "off",
+        "Result-integrity mode of the survey scheduler "
+        "(riptide_tpu/survey/integrity.py). `off` = nothing (no fold, "
+        "no extra dispatches — the pre-PR-18 fast path). `digest` = "
+        "Ring 1: per-chunk result digests journaled in an `integrity` "
+        "block and re-verified on resume. `probe` = Ring 1 + Ring 2 "
+        "shadow recompute probes per RIPTIDE_INTEGRITY_PROBE_EVERY "
+        "(mismatch -> `result_mismatch` incident + third-dispatch "
+        "vote; persistent mismatch -> suspect-device quarantine), "
+        "plus the golden canary on every quarantine decision. "
+        "`strict` = probe EVERY chunk and run the canary at scheduler "
+        "warmup, aborting before tenant work if it misses its pinned "
+        "digest. Serve jobs can override per job via the spec's "
+        "`integrity` field.",
+        since="PR 18 (0.17.0)",
+        choices=("off", "digest", "probe", "strict"),
+    ),
+    EnvFlag(
+        "RIPTIDE_INTEGRITY_PROBE_EVERY", "int", 0,
+        "Shadow-probe cadence of `RIPTIDE_INTEGRITY=probe`: dispatch "
+        "every Nth chunk twice through the already-compiled "
+        "executables and compare result digests bit-exactly before "
+        "the record is written. `0` disables probing (digest-only "
+        "even in probe mode); `strict` mode probes every chunk "
+        "regardless.",
+        since="PR 18 (0.17.0)",
     ),
 ]
 

@@ -1,0 +1,470 @@
+"""
+Result-integrity layer tests (PR 18): the fold-digest machinery, config
+parsing, the dispatch-count contract per mode (off = zero overhead on
+the device path), shadow-probe detection and out-voting of a transient
+in-flight bitflip, quarantine + park + clean resume to identical peaks
+under persistent corruption, resume-time digest re-verification,
+pre-PR-18 journal compatibility, and the golden canary's verdicts.
+
+Everything runs on the CPU backend against tiny synthetic surveys —
+the machinery under test is the integrity plumbing, not the search.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from riptide_tpu.survey import integrity
+from riptide_tpu.survey.faults import FaultAbort, FaultPlan
+from riptide_tpu.survey.integrity import (
+    IntegrityConfig, IntegrityManager, IntegrityQuarantineError,
+    fold_result, peaks_digest,
+)
+from riptide_tpu.survey.journal import SurveyJournal
+from riptide_tpu.survey.metrics import get_metrics
+from riptide_tpu.survey.scheduler import RetryPolicy, SurveyScheduler
+from riptide_tpu.peak_detection import Peak
+
+from synth import generate_data_presto
+
+TOBS = 16.0
+TSAMP = 1e-3
+PERIOD = 0.5
+
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+
+def _peak(period=0.5, snr=10.0, dm=0.0):
+    return Peak(period=period, freq=1.0 / period, width=3, ducy=0.05,
+                iw=1, ip=7, snr=snr, dm=dm)
+
+
+def _searcher():
+    from riptide_tpu.pipeline.batcher import BatchSearcher
+
+    return BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                         SEARCH_CONF, fmt="presto", io_threads=1)
+
+
+def _two_trials(tmp_path):
+    f1 = generate_data_presto(str(tmp_path), "a_DM0.00", tobs=TOBS,
+                              tsamp=TSAMP, period=PERIOD, dm=0.0)
+    f2 = generate_data_presto(str(tmp_path), "b_DM5.00", tobs=TOBS,
+                              tsamp=TSAMP, period=PERIOD, dm=5.0)
+    return f1, f2
+
+
+def _fast_retry():
+    return RetryPolicy(max_retries=3, base_s=0.01, cap_s=0.02,
+                       sleep=lambda s: None)
+
+
+class _CountingScheduler(SurveyScheduler):
+    """Spy on the device-dispatch path: every shadow probe and every
+    retry lands here, so the count IS the number of device round
+    trips."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.dispatches = 0
+
+    def _dispatch_once(self, *args, **kwargs):
+        self.dispatches += 1
+        return super()._dispatch_once(*args, **kwargs)
+
+
+# ------------------------------------------------------------- fold digest
+
+def test_fold_accumulator_deterministic_and_sensitive():
+    a = np.arange(24, dtype=np.float32).reshape(2, 12)
+    b = np.arange(7, dtype=np.int32)
+    acc1 = integrity._FoldAccumulator()
+    acc1.fold(a)
+    acc1.fold(b)
+    acc2 = integrity._FoldAccumulator()
+    acc2.fold(a.copy())
+    acc2.fold(b.copy())
+    assert acc1.hexdigest() == acc2.hexdigest()
+    assert acc1.nbuf == 2
+
+    flipped = a.copy()
+    flipped.view(np.uint8).reshape(-1)[5] ^= 0xFF
+    acc3 = integrity._FoldAccumulator()
+    acc3.fold(flipped)
+    acc3.fold(b)
+    assert acc3.hexdigest() != acc1.hexdigest()
+    # Same bytes, different shape: still distinct (shape is folded).
+    acc4 = integrity._FoldAccumulator()
+    acc4.fold(a.reshape(4, 6))
+    acc4.fold(b)
+    assert acc4.hexdigest() != acc1.hexdigest()
+    assert integrity._FoldAccumulator().hexdigest() is None
+
+
+def test_fold_result_is_noop_without_accumulator():
+    buf = np.arange(10.0)
+    assert fold_result(buf) is buf  # no copy, no digest, no state
+
+
+def test_fold_accumulator_corrupt_hit_flips_one_byte_once():
+    a = np.zeros(8, dtype=np.float32)
+    acc = integrity._FoldAccumulator(corrupt_hit=3)
+    out = acc.fold(a)
+    assert (a == 0).all()  # the caller's buffer is never mutated
+    assert np.asarray(out).view(np.uint8)[3] == 0xFF
+    # One-shot: the second fold of the same attempt is untouched.
+    out2 = acc.fold(np.zeros(8, dtype=np.float32))
+    assert (np.asarray(out2) == 0).all()
+
+
+def test_peaks_digest_canonical():
+    peaks = [_peak(snr=9.0), _peak(period=1.0, snr=8.0, dm=10.0)]
+    assert peaks_digest(peaks) == peaks_digest(list(peaks))
+    assert peaks_digest(peaks) != peaks_digest(peaks[:1])
+    bumped = [_peak(snr=9.5), peaks[1]]
+    assert peaks_digest(bumped) != peaks_digest(peaks)
+    assert peaks_digest([]) == peaks_digest([])
+
+
+# ------------------------------------------------------------------ config
+
+def test_config_modes_and_validation():
+    assert not IntegrityConfig().enabled
+    assert IntegrityConfig(mode="digest").enabled
+    assert not IntegrityConfig(mode="digest").probing
+    assert IntegrityConfig(mode="probe", probe_every=2).probing
+    assert not IntegrityConfig(mode="probe", probe_every=0).probing
+    # strict always probes: probe_every is forced to at least 1.
+    assert IntegrityConfig(mode="strict").probe_every == 1
+    with pytest.raises(ValueError):
+        IntegrityConfig(mode="sideways")
+    with pytest.raises(ValueError):
+        IntegrityConfig(mode="probe", policy="shrug")
+
+
+def test_config_from_spec():
+    cfg = IntegrityConfig.from_spec("probe", policy="fail")
+    assert (cfg.mode, cfg.probe_every, cfg.policy) == ("probe", 1, "fail")
+    assert IntegrityConfig.from_spec("digest").probe_every == 0
+    cfg = IntegrityConfig.from_spec({"mode": "probe", "probe_every": 3})
+    assert (cfg.mode, cfg.probe_every) == ("probe", 3)
+    with pytest.raises(ValueError):
+        IntegrityConfig.from_spec("sideways")
+    with pytest.raises(ValueError):
+        IntegrityConfig.from_spec(42)
+
+
+def test_config_from_env(monkeypatch):
+    monkeypatch.delenv("RIPTIDE_INTEGRITY", raising=False)
+    assert not IntegrityConfig.from_env().enabled
+    monkeypatch.setenv("RIPTIDE_INTEGRITY", "probe")
+    monkeypatch.setenv("RIPTIDE_INTEGRITY_PROBE_EVERY", "4")
+    cfg = IntegrityConfig.from_env()
+    assert (cfg.mode, cfg.probe_every) == ("probe", 4)
+    # None spec falls through to the environment.
+    assert IntegrityConfig.from_spec(None).probe_every == 4
+
+
+def test_probe_due_cadence():
+    mgr = IntegrityManager(IntegrityConfig(mode="probe", probe_every=2))
+    assert [mgr.probe_due(i) for i in range(4)] == [True, False, True,
+                                                   False]
+    mgr.quarantined = True
+    assert not mgr.probe_due(0)
+    strict = IntegrityManager(IntegrityConfig(mode="strict"))
+    assert all(strict.probe_due(i) for i in range(3))
+    assert not IntegrityManager(
+        IntegrityConfig(mode="digest")).probe_due(0)
+
+
+# --------------------------------------------- scheduler: modes end to end
+
+def test_off_mode_no_extra_dispatches_no_new_record_fields(tmp_path):
+    get_metrics().reset()
+    f1, f2 = _two_trials(tmp_path)
+    journal = SurveyJournal(tmp_path / "j")
+    sched = _CountingScheduler(_searcher(), [[f1], [f2]],
+                               journal=journal)
+    assert sched.integrity is None  # off: zero integrity state
+    peaks = sched.run()
+    assert peaks
+    assert sched.dispatches == 2  # one device round trip per chunk
+    # Off-mode chunk records are byte-compatible with pre-PR-18 ones:
+    # neither the integrity block nor the retry attribution appears.
+    done = journal.completed_chunks()
+    for cid in (0, 1):
+        assert "integrity" not in done[cid][0]
+        assert "device_error_retries" not in done[cid][0]
+    assert get_metrics().counter("shadow_probes") == 0
+
+
+def test_digest_mode_records_blocks_without_probing(tmp_path):
+    get_metrics().reset()
+    f1, f2 = _two_trials(tmp_path)
+    journal = SurveyJournal(tmp_path / "j")
+    sched = _CountingScheduler(
+        _searcher(), [[f1], [f2]], journal=journal,
+        integrity=IntegrityConfig(mode="digest"))
+    sched.run()
+    assert sched.dispatches == 2  # Ring 1 never adds a dispatch
+    done = journal.completed_chunks()
+    for cid in (0, 1):
+        blk = done[cid][0]["integrity"]
+        assert blk["algo"] == "sha256" and blk["mode"] == "digest"
+        assert len(blk["result"]) == 64
+        assert blk["path"] == "batch"
+        assert not blk.get("probe")
+        # The peaks digest is recomputable from the replayed rows.
+        assert blk["peaks"] == peaks_digest(done[cid][1])
+    assert get_metrics().counter("integrity_checks") >= 2
+    assert get_metrics().counter("shadow_probes") == 0
+
+
+def test_probe_mode_clean_run_double_dispatches(tmp_path):
+    get_metrics().reset()
+    f1, f2 = _two_trials(tmp_path)
+    journal = SurveyJournal(tmp_path / "j")
+    sched = _CountingScheduler(
+        _searcher(), [[f1], [f2]], journal=journal,
+        integrity=IntegrityConfig(mode="probe", probe_every=1))
+    peaks = sched.run()
+    assert peaks
+    assert sched.dispatches == 4  # primary + shadow per chunk, no vote
+    done = journal.completed_chunks()
+    for cid in (0, 1):
+        blk = done[cid][0]["integrity"]
+        assert blk["probe"] is True
+        assert "votes" not in blk  # agreement needs no arbitration
+    assert get_metrics().counter("shadow_probes") == 2
+    assert get_metrics().counter("integrity_mismatches") == 0
+    assert not journal.incidents()
+
+
+def test_transient_bitflip_detected_and_outvoted(tmp_path):
+    f1, f2 = _two_trials(tmp_path)
+    get_metrics().reset()
+    control = SurveyScheduler(_searcher(), [[f1], [f2]]).run()
+
+    get_metrics().reset()
+    journal = SurveyJournal(tmp_path / "j")
+    sched = _CountingScheduler(
+        _searcher(), [[f1], [f2]], journal=journal,
+        integrity=IntegrityConfig(mode="probe", probe_every=1),
+        faults=FaultPlan.parse("bitflip:1"), retry=_fast_retry())
+    peaks = sched.run()
+    # Corrupted primary, clean shadow, clean tie-break: 2:1 against the
+    # flip, the run completes, and the data product is unharmed.
+    assert peaks == control
+    assert sched.dispatches == 5  # 2 + (1 primary + 2 shadows)
+    assert get_metrics().counter("integrity_mismatches") == 1
+    kinds = [rec["incident"] for rec in journal.incidents()]
+    assert kinds.count("result_mismatch") == 1
+    assert "integrity_quarantine" not in kinds
+    blk = journal.completed_chunks()[1][0]["integrity"]
+    assert blk["probe"] is True and len(blk["votes"]) == 3
+
+
+def test_persistent_bitflip_quarantines_parks_then_resumes(tmp_path):
+    f1, f2 = _two_trials(tmp_path)
+    get_metrics().reset()
+    control = SurveyScheduler(_searcher(), [[f1], [f2]]).run()
+
+    # Every one of chunk 0's three dispatches flips a DIFFERENT byte:
+    # three distinct digests, no majority, device marked suspect.
+    get_metrics().reset()
+    jdir = tmp_path / "j"
+    sched = SurveyScheduler(
+        _searcher(), [[f1], [f2]], journal=SurveyJournal(jdir),
+        integrity=IntegrityConfig(mode="probe", probe_every=1),
+        faults=FaultPlan.parse("bitflip:0x3"), retry=_fast_retry())
+    degraded = sched.run()
+    assert degraded == []  # chunk 0 quarantined, chunk 1 latched parked
+    assert sched.integrity.quarantined is True
+    journal = SurveyJournal(jdir)
+    assert sorted(journal.completed_chunks()) == []
+    kinds = [rec["incident"] for rec in journal.incidents()]
+    assert "result_mismatch" in kinds
+    assert "integrity_quarantine" in kinds
+    assert kinds.count("chunk_parked") == 2
+    quar = next(rec for rec in journal.incidents()
+                if rec["incident"] == "integrity_quarantine")
+    assert len(quar["detail"]["digests"]) == 3
+    assert quar["detail"]["policy"] == "park"
+
+    # A clean scheduler (fresh latch — "the replaced device") resumes
+    # the parked chunks to the identical data product.
+    get_metrics().reset()
+    resumed = SurveyScheduler(
+        _searcher(), [[f1], [f2]], journal=SurveyJournal(jdir),
+        resume=True,
+        integrity=IntegrityConfig(mode="probe", probe_every=1)).run()
+    assert resumed == control
+    assert sorted(SurveyJournal(jdir).completed_chunks()) == [0, 1]
+
+
+def test_quarantine_policy_fail_raises(tmp_path):
+    get_metrics().reset()
+    f1, _ = _two_trials(tmp_path)
+    journal = SurveyJournal(tmp_path / "j")
+    sched = SurveyScheduler(
+        _searcher(), [[f1]], journal=journal,
+        integrity=IntegrityConfig(mode="probe", probe_every=1,
+                                  policy="fail"),
+        faults=FaultPlan.parse("bitflip:0x3"), retry=_fast_retry())
+    with pytest.raises(IntegrityQuarantineError) as exc:
+        sched.run()
+    assert exc.value.chunk_id == 0
+    assert len(exc.value.digests) == 3
+    kinds = [rec["incident"] for rec in journal.incidents()]
+    assert "integrity_quarantine" in kinds
+
+
+def test_replay_digest_mismatch_emits_incident(tmp_path):
+    """A journaled peaks digest that no longer matches the replayed
+    rows is a detected (non-fatal) event on resume."""
+    get_metrics().reset()
+    f1, f2 = _two_trials(tmp_path)
+    jdir = tmp_path / "j"
+    with pytest.raises(FaultAbort):
+        SurveyScheduler(
+            _searcher(), [[f1], [f2]], journal=SurveyJournal(jdir),
+            integrity=IntegrityConfig(mode="digest"),
+            faults=FaultPlan.parse("abort:1")).run()
+    journal = SurveyJournal(jdir)
+    rec, peaks0 = journal.completed_chunks()[0]
+    # Re-record chunk 0 (last record wins on replay) with a forged
+    # digest — the tamper-evidence scenario Ring 1 exists for.
+    forged = dict(rec["integrity"], peaks="0" * 64)
+    journal.record_chunk(0, rec["files"], rec["dms"], peaks0,
+                         wire_digest=rec["wire_digest"],
+                         extra={"integrity": forged})
+
+    get_metrics().reset()
+    resumed = SurveyScheduler(
+        _searcher(), [[f1], [f2]], journal=SurveyJournal(jdir),
+        resume=True, integrity=IntegrityConfig(mode="digest")).run()
+    assert resumed  # the replay proceeds: forensic record, not a crash
+    inc = [r for r in SurveyJournal(jdir).incidents()
+           if r["incident"] == "result_mismatch"]
+    assert len(inc) == 1 and inc[0]["detail"]["replayed"] is True
+    assert get_metrics().counter("integrity_mismatches") == 1
+
+
+def test_pre_pr18_journal_resumes_with_integrity_on(tmp_path):
+    """Journals written before the integrity layer (no ``integrity``
+    blocks) resume cleanly under an integrity-enabled scheduler: the
+    replay verification skips silently, no incidents appear."""
+    f1, f2 = _two_trials(tmp_path)
+    get_metrics().reset()
+    control = SurveyScheduler(_searcher(), [[f1], [f2]]).run()
+
+    jdir = tmp_path / "j"
+    with pytest.raises(FaultAbort):
+        SurveyScheduler(  # integrity off: pre-PR-18 record shape
+            _searcher(), [[f1], [f2]], journal=SurveyJournal(jdir),
+            faults=FaultPlan.parse("abort:1")).run()
+    assert "integrity" not in SurveyJournal(jdir).completed_chunks()[0][0]
+
+    get_metrics().reset()
+    resumed = SurveyScheduler(
+        _searcher(), [[f1], [f2]], journal=SurveyJournal(jdir),
+        resume=True,
+        integrity=IntegrityConfig(mode="digest")).run()
+    assert resumed == control
+    assert not [r for r in SurveyJournal(jdir).incidents()
+                if r["incident"] == "result_mismatch"]
+    # And the reporting side shrugs at the mixed journal too.
+    from riptide_tpu.obs import report
+
+    rep = report.build_report(str(jdir))
+    assert rep["integrity"]["chunks_digested"] >= 1
+    report.render_text(rep)
+
+
+def test_device_error_retry_attribution_in_chunk_record(tmp_path):
+    get_metrics().reset()
+    f1, f2 = _two_trials(tmp_path)
+    journal = SurveyJournal(tmp_path / "j")
+    sched = SurveyScheduler(
+        _searcher(), [[f1], [f2]], journal=journal,
+        integrity=IntegrityConfig(mode="digest"),
+        faults=FaultPlan.parse("device_error:1"), retry=_fast_retry())
+    sched.run()
+    done = journal.completed_chunks()
+    # The run-wide counter is monotone; the per-chunk extra pins the
+    # retry to the chunk that actually suffered it.
+    assert "device_error_retries" not in done[0][0]
+    assert done[1][0]["device_error_retries"] == 1
+
+
+# ------------------------------------------------------------------ canary
+
+def test_canary_verdicts(tmp_path):
+    get_metrics().reset()
+    digest = integrity.compute_canary_digest()
+    assert digest and len(digest) == 64
+    platform = integrity._canary_platform()
+
+    good = tmp_path / "pin_good.json"
+    good.write_text(json.dumps(
+        {"v": 1, "algo": "sha256", "platform_digests": {platform: digest}}))
+    mgr = IntegrityManager(IntegrityConfig(
+        mode="probe", probe_every=1, canary_pin=str(good)))
+    assert mgr.canary_verdict() == "ok"
+
+    bad = tmp_path / "pin_bad.json"
+    bad.write_text(json.dumps(
+        {"v": 1, "algo": "sha256",
+         "platform_digests": {platform: "0" * 64}}))
+    mgr = IntegrityManager(IntegrityConfig(
+        mode="strict", canary_pin=str(bad)))
+    assert mgr.canary_verdict() == "failed"
+    with pytest.raises(RuntimeError):
+        mgr.startup_canary()
+
+    # No pin for this platform: pass-with-note, never fatal.
+    empty = tmp_path / "pin_none.json"
+    empty.write_text(json.dumps(
+        {"v": 1, "algo": "sha256", "platform_digests": {}}))
+    mgr = IntegrityManager(IntegrityConfig(
+        mode="strict", canary_pin=str(empty)))
+    assert mgr.canary_verdict() == "unpinned"
+    assert mgr.startup_canary() == "unpinned"
+
+
+def test_checked_in_cpu_canary_pin_is_current():
+    """The pin shipped in tools/integrity_canary.json must match what
+    this tree actually computes (the `make repin` contract)."""
+    pins = integrity._read_canary_pin(integrity.canary_pin_path())
+    platform = integrity._canary_platform()
+    if platform not in pins:
+        pytest.skip(f"no canary pin for platform {platform!r}")
+    assert integrity.compute_canary_digest() == pins[platform]
+
+
+# ------------------------------------------------------------- watch/report
+
+def test_watch_snapshot_surfaces_integrity_counters(tmp_path):
+    get_metrics().reset()
+    f1, f2 = _two_trials(tmp_path)
+    jdir = tmp_path / "j"
+    SurveyScheduler(
+        _searcher(), [[f1], [f2]], journal=SurveyJournal(jdir),
+        integrity=IntegrityConfig(mode="probe", probe_every=1),
+        faults=FaultPlan.parse("bitflip:1"), retry=_fast_retry()).run()
+    from riptide_tpu.obs import report
+
+    state = report.read_journal(str(jdir))
+    snap = report.watch_snapshot(state)
+    assert snap["integrity_mismatches"] == 1
+    assert snap["integrity_probed"] == 2
+    stats = report.integrity_stats(state["chunks"], state["incidents"])
+    assert stats["chunks_digested"] == 2
+    assert stats["chunks_probed"] == 2
+    assert stats["chunks_voted"] == 1
+    assert stats["mismatch_incidents"] == 1
+    assert stats["device_verdict"] == "ok"  # detected, out-voted, no latch

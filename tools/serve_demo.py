@@ -31,6 +31,13 @@ Four legs:
    must finish the in-flight chunk, park the job WITHOUT a terminal
    registry record and exit **0**; the restart re-queues it
    (``resumed``) and serves a byte-identical ``peaks.csv``.
+5. **result-integrity containment (PR 18)** — one daemon, two
+   concurrent tenants: a job with a persistent ``bitflip`` fault and
+   ``integrity: probe`` must FAIL with ``integrity_quarantine`` (the
+   serve-side quarantine policy fails only the implicated job), with
+   the mismatch/quarantine incidents contained to its own journal,
+   while the concurrent clean job completes byte-identical to its
+   batch control; a malformed integrity spec 400s at admission.
 
 Output directory: /tmp/riptide_serve_demo (or argv[1]). ``make
 serve-demo`` runs this; it is wired into ``make check-full``.
@@ -123,6 +130,18 @@ def _chunk_count(journal_path):
     entries, _ = fsio.scan_jsonl(journal_path)
     return sum(1 for obj, _status, _off in entries
                if obj and obj.get("kind") == "chunk")
+
+
+def _journal_incidents(root, jid):
+    """Incident kinds journaled into ONE job's own survey journal —
+    the containment check's evidence (integrity incidents must appear
+    in the implicated job's journal and nowhere else)."""
+    from riptide_tpu.utils import fsio
+
+    path = os.path.join(root, "jobs", jid, "journal.jsonl")
+    entries, _ = fsio.scan_jsonl(path)
+    return [obj.get("incident") for obj, _status, _off in entries
+            if isinstance(obj, dict) and obj.get("kind") == "incident"]
 
 
 def _fold_registry(root):
@@ -332,8 +351,53 @@ def main(outdir="/tmp/riptide_serve_demo"):
           "non-terminally; restart resumed it to byte-identical "
           "peaks.csv")
 
-    print(f"\nserve demo OK: 5 service jobs across 3 daemons")
-    print(f"  serve dirs ->  {serve1}  {serve2}  {serve3}")
+    # -- leg 5: result-integrity containment (PR 18) ------------------
+    serve4 = os.path.join(outdir, "serve4")
+    daemon = ServeDaemon(serve4, port=0, workers=2).start()
+    base = f"http://127.0.0.1:{daemon.port}"
+    try:
+        # Job A's device cannot agree with itself: every one of chunk
+        # 1's three dispatches (primary, shadow, tie-break) flips a
+        # DIFFERENT result byte, so the vote cannot resolve and the
+        # serve quarantine policy ("fail", never park) must end this
+        # job — and only this job — as failed.
+        spec_bad = _spec(files_a, "alice")
+        spec_bad["fault_inject"] = "bitflip:1x3"
+        spec_bad["integrity"] = {"mode": "probe", "probe_every": 1}
+        code, doc_bad = _req_json(base, "/jobs", "POST", spec_bad)
+        assert code == 202, doc_bad
+        code, doc_ok = _req_json(base, "/jobs", "POST",
+                                 _spec(files_b, "bob"))
+        assert code == 202, doc_ok
+        bad = _wait_terminal(base, doc_bad["job_id"])
+        ok = _wait_terminal(base, doc_ok["job_id"])
+        assert bad["status"] == "failed", bad
+        assert "mismatch" in (bad.get("error") or ""), bad
+        assert ok["status"] == "done", ok.get("error")
+        assert _req(base, f"/jobs/{doc_ok['job_id']}/peaks")[1] \
+            == control_b, "clean job alongside a quarantined one " \
+            "diverged from its batch control"
+        inc_bad = _journal_incidents(serve4, doc_bad["job_id"])
+        assert "result_mismatch" in inc_bad, inc_bad
+        assert "integrity_quarantine" in inc_bad, inc_bad
+        inc_ok = _journal_incidents(serve4, doc_ok["job_id"])
+        leaked = [k for k in inc_ok if k in (
+            "result_mismatch", "integrity_quarantine", "canary_failed")]
+        assert not leaked, \
+            f"integrity incidents leaked into the clean job: {leaked}"
+        # A typo'd integrity spec is rejected at admission, not at run.
+        spec_nope = _spec(files_b, "bob")
+        spec_nope["integrity"] = "sideways"
+        code, err = _req_json(base, "/jobs", "POST", spec_nope)
+        assert code == 400, (code, err)
+    finally:
+        daemon.stop()
+    print("integrity OK: bitflipped job failed with integrity_quarantine "
+          "contained to its own journal; concurrent clean job "
+          "byte-identical to control")
+
+    print(f"\nserve demo OK: 7 service jobs across 4 daemons")
+    print(f"  serve dirs ->  {serve1}  {serve2}  {serve3}  {serve4}")
     sys.stdout.write(frame)
     return 0
 

@@ -1,0 +1,46 @@
+"""
+Re-pin tools/integrity_canary.json for the running backend.
+
+The result-integrity layer's Ring 3 golden canary
+(riptide_tpu/survey/integrity.py) runs a tiny pinned-input search and
+compares the collected-buffer digest against the per-platform pin in
+this file — the "is the DEVICE wrong?" oracle consulted at strict-mode
+startup and on every quarantine decision. Run this after a deliberate
+kernel/layout change shifts the canary's bytes (the `make repin`
+workflow, next to the kernel-digest and plan-contract pins). A
+platform with no pin is reported as `unpinned` by the canary —
+pass-with-note, never fatal — so pinning a new backend is additive.
+
+Usage: JAX_PLATFORMS=cpu python tools/update_canary_digest.py
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+PATH = os.path.join(os.path.dirname(__file__), "integrity_canary.json")
+
+
+def main():
+    from riptide_tpu.survey import integrity
+
+    try:
+        with open(PATH) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {"v": 1, "algo": "sha256", "platform_digests": {}}
+    import jax
+
+    platform = str(jax.default_backend())
+    digest = integrity.compute_canary_digest()
+    old = data["platform_digests"].get(platform)
+    data["platform_digests"][platform] = digest
+    with open(PATH, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    print(f"canary [{platform}]: {old} -> {digest}")
+
+
+if __name__ == "__main__":
+    main()
