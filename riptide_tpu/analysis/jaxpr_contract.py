@@ -394,6 +394,24 @@ def extract_contract(name, plan, path=None, mode=None, programs=None):
     out_dtype = str(chunk_closed.jaxpr.outvars[0].aval.dtype)
     model = hbm_model(plan, path=path, mode=mode)
 
+    # The post-search peak program (PR 19): under default env semantics
+    # RIPTIDE_DEVICE_CLUSTER is on, so the fused peak program carries
+    # the on-device clustering + harmonic-screen sections. The block
+    # pins its structure — the dtype-flow audit (RPV002 is absolute
+    # here too) and the pulled bytes per DM trial, i.e. the size of the
+    # ONE result pull the path contracts to.
+    peak_fn, peak_args, pp = engine.staged_peak_program(plan, PROBE_D)
+    peak_closed = jax.make_jaxpr(peak_fn)(*peak_args)
+    peaks = {
+        "device_cluster": bool(pp.device_cluster),
+        "f64_eqns": count_f64_eqns(peak_closed),
+        "out_bytes_per_dm": int(sum(aval_bytes(v.aval)
+                                    for v in peak_closed.jaxpr.outvars)
+                                // PROBE_D),
+        "out_dtype": str(peak_closed.jaxpr.outvars[0].aval.dtype),
+    }
+    dtypes.update(collect_dtypes(peak_closed))
+
     return {
         "path": path,
         "wire_mode": mode,
@@ -404,6 +422,7 @@ def extract_contract(name, plan, path=None, mode=None, programs=None):
         "donation": {"donated": int(donated), "dropped": int(dropped)},
         "dtypes": sorted(dtypes),
         "out_dtype": out_dtype,
+        "peaks": peaks,
         "hbm": model.to_dict(),
     }
 
@@ -465,6 +484,15 @@ def check_contracts(pinned_doc, current, all_names,
                     "dropped (declared but not aliased to any output) "
                     "— the donated HBM is silently double-counted; fix "
                     "the program shape or drop the donation"))
+
+        pk = cur.get("peaks")
+        if pk and pk.get("f64_eqns"):
+            findings.append(_finding(
+                contract_rel, "RPV002",
+                f"plan {name!r} peak program: {pk['f64_eqns']} "
+                "float64-producing op(s) in the traced program — the "
+                "dtype-flow audit forbids f64 on device (fix the "
+                "promotion; --update cannot bless it)"))
 
         pin = pinned_plans.get(name)
         if pin is None:
@@ -546,6 +574,13 @@ def check_contracts(pinned_doc, current, all_names,
                 contract_rel, "RPV003",
                 f"plan {name!r}: donation contract drift — pinned "
                 f"{pin.get('donation')} != traced {cur['donation']}"))
+        if cur.get("peaks") != pin.get("peaks"):
+            findings.append(_finding(
+                contract_rel, "RPV001",
+                f"plan {name!r}: peak-program contract drift — pinned "
+                f"{pin.get('peaks')} != traced {cur.get('peaks')} (the "
+                "fused peak program's structure or pulled bytes "
+                "changed; re-pin with --update only if intentional)"))
         if cur["hbm"] != pin.get("hbm"):
             findings.append(_finding(
                 contract_rel, "RPV005",

@@ -333,7 +333,7 @@ def circular_prefix_sum(data, nsum):
 
 
 def downsample_stages(batch, imin, imax, wmin, wmax, wint, dtype=np.float32,
-                      nthreads=None):
+                      nthreads=None, out=None):
     """
     All cascade stages' real-factor downsamplings of a (D, N) float32
     batch, threaded over (stage, trial) pairs with per-trial float64
@@ -341,7 +341,10 @@ def downsample_stages(batch, imin, imax, wmin, wmax, wint, dtype=np.float32,
 
     imin/imax : (S, nout) int32; wmin/wmax/wint : (S, nout) float32.
     Returns (S, D, nout) in ``dtype`` (float32 or float16 — the float16
-    conversion is done natively, round-to-nearest-even).
+    conversion is done natively, round-to-nearest-even). ``out``, when
+    given, must be a C-contiguous (S, D, nout) array of ``dtype`` and
+    is written in place (zero-copy staging: a recycled buffer skips
+    the per-chunk allocation + page-fault cost).
     """
     lib = _require()
     batch = np.ascontiguousarray(batch, np.float32)
@@ -352,7 +355,11 @@ def downsample_stages(batch, imin, imax, wmin, wmax, wint, dtype=np.float32,
         raise ValueError("dtype must be float32 or float16")
     if nthreads is None:
         nthreads = min(max(os.cpu_count() or 1, 1), 32)
-    out = np.empty((S, D, nout), dtype)
+    if out is None:
+        out = np.empty((S, D, nout), dtype)
+    elif out.shape != (S, D, nout) or out.dtype != dtype \
+            or not out.flags["C_CONTIGUOUS"]:
+        raise ValueError("out must be C-contiguous (S, D, nout) of dtype")
     lib.rn_downsample_stages(
         batch, D, N,
         np.ascontiguousarray(imin, np.int32),
@@ -370,7 +377,8 @@ _WIRE_MODE_CODE = {"uint6": 6, "uint8": 8, "uint12": 12}
 
 
 def prepare_wire_view(batch, imin, imax, wmin, wmax, wint, nouts, mode,
-                      PW, roffs, tot_rows, soffs, stot, nthreads=None):
+                      PW, roffs, tot_rows, soffs, stot, nthreads=None,
+                      out=None, scales=None):
     """
     Quantised wire preparation of a (D, N) float32 batch in the
     kernel-decodable byte-plane view (the single-pass native mirror of
@@ -381,7 +389,11 @@ def prepare_wire_view(batch, imin, imax, wmin, wmax, wint, nouts, mode,
 
     Returns (wire (D, tot_rows, PW) uint8, scales (D, stot) float32);
     the slack regions ship as zeros / 1.0 so the fused kernel's DMA
-    over-reads stay finite.
+    over-reads stay finite. ``out`` / ``scales``, when given, must be
+    C-contiguous arrays of the returned shapes/dtypes and are written
+    in place (zero-copy staging); they are re-initialised to the
+    zeros / 1.0 slack values first, so a recycled buffer produces
+    byte-identical wires.
     """
     lib = _require()
     batch = np.ascontiguousarray(batch, np.float32)
@@ -389,8 +401,21 @@ def prepare_wire_view(batch, imin, imax, wmin, wmax, wint, nouts, mode,
     S, nout_pad = imin.shape
     if nthreads is None:
         nthreads = min(max(os.cpu_count() or 1, 1), 32)
-    out = np.zeros((D, int(tot_rows), int(PW)), np.uint8)
-    scales = np.ones((D, int(stot)), np.float32)
+    if out is None:
+        out = np.zeros((D, int(tot_rows), int(PW)), np.uint8)
+    else:
+        if out.shape != (D, int(tot_rows), int(PW)) \
+                or out.dtype != np.uint8 or not out.flags["C_CONTIGUOUS"]:
+            raise ValueError("out must be C-contiguous (D, rows, PW) uint8")
+        out.fill(0)
+    if scales is None:
+        scales = np.ones((D, int(stot)), np.float32)
+    else:
+        if scales.shape != (D, int(stot)) \
+                or scales.dtype != np.float32 \
+                or not scales.flags["C_CONTIGUOUS"]:
+            raise ValueError("scales must be C-contiguous (D, stot) f32")
+        scales.fill(1.0)
     lib.rn_prepare_wire_view(
         batch, D, N,
         np.ascontiguousarray(imin, np.int32),

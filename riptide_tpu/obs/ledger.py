@@ -61,6 +61,12 @@ FINGERPRINT_EXCLUDE = frozenset({
     "RIPTIDE_SERVE", "RIPTIDE_SERVE_MAX_JOBS",
     "RIPTIDE_SERVE_QUOTA_DEVICE_S", "RIPTIDE_SERVE_PORT",
     "RIPTIDE_SERVE_DIR", "RIPTIDE_SERVE_DRAIN_TIMEOUT_S",
+    # Wire-prep thread count (PR 19): a pure throughput knob — the
+    # native job pool writes disjoint output regions per (stage, trial)
+    # job, so wire bytes are identical at any thread count. Two runs
+    # differing only in core count must fingerprint as the same flag
+    # regime or every thread-count experiment would break --compare.
+    "RIPTIDE_PREP_THREADS",
 })
 
 

@@ -39,7 +39,8 @@ __all__ = [
     "PHASE_SUM_TOL", "SERIAL_PHASES", "JournalFollower", "read_journal",
     "read_heartbeats", "read_ledger", "parse_prom_text",
     "load_trace_summary", "run_decomposition_from_chunks",
-    "phase_attribution", "stragglers", "tunnel_stats", "hbm_stats",
+    "phase_attribution", "host_tail_stats", "stragglers",
+    "tunnel_stats", "hbm_stats",
     "read_fleet", "merge_fleet", "read_jobs", "job_table",
     "render_jobs_text", "watch_snapshot", "build_report",
     "render_text", "render_fleet_text", "compare_to_ledger",
@@ -646,11 +647,13 @@ def run_decomposition_from_chunks(timings):
     timings = [t for t in timings if t]
     n = len(timings)
     out = {"prep_s": 0.0, "wire_s": 0.0, "device_s": 0.0,
+           "cluster_s": 0.0, "postsearch_s": 0.0,
            "chunk_s": 0.0, "wire_MBps": None}
     bound_counts = {}
     if not n:
         return out, 0, bound_counts
-    for key in ("prep_s", "wire_s", "device_s"):
+    for key in ("prep_s", "wire_s", "device_s", "cluster_s",
+                "postsearch_s"):
         out[key] = round(sum(float(t.get(key, 0.0)) for t in timings), 6)
     out["chunk_s"] = round(
         sum(float(t.get("chunk_s", 0.0)) for t in timings) / n, 6)
@@ -693,6 +696,33 @@ def phase_attribution(chunks):
             for p in SERIAL_PHASES]
     rows.append(("prep (overlapped)", round(prep, 6), None))
     return rows, violations
+
+
+def host_tail_stats(chunks):
+    """The post-pull host tail of the collects over the journaled
+    chunks: total ``postsearch_s`` (everything between the device pull
+    and the collect's return) and its ``cluster_s`` clustering slice,
+    each with its share of total ``collect_s`` — the share
+    ``RIPTIDE_DEVICE_CLUSTER`` exists to shrink. Pre-PR-19 journals
+    carry neither key; their totals read 0.0 and the shares None."""
+    cluster = postsearch = collect = 0.0
+    seen = False
+    for rec in chunks.values():
+        t = rec.get("timings") or {}
+        if "postsearch_s" in t or "cluster_s" in t:
+            seen = True
+        cluster += float(t.get("cluster_s", 0.0))
+        postsearch += float(t.get("postsearch_s", 0.0))
+        collect += float(t.get("collect_s", 0.0))
+    share = (lambda v: round(v / collect, 4) if seen and collect > 0
+             else None)
+    return {
+        "cluster_s": round(cluster, 6),
+        "postsearch_s": round(postsearch, 6),
+        "collect_s": round(collect, 6),
+        "cluster_share_of_collect": share(cluster),
+        "postsearch_share_of_collect": share(postsearch),
+    }
 
 
 def stragglers(chunks, factor=STRAGGLER_FACTOR):
@@ -832,6 +862,7 @@ def build_report(journal_dir, trace_path=None, prom_path=None):
                    for cid, rec in j["parked"].items()},
         "run": dict(run, nchunks=nchunks, bound_counts=bound_counts),
         "phase_table": rows,
+        "host_tail": host_tail_stats(chunks),
         "phase_sum_violations": violations,
         "stragglers": stragglers(chunks),
         "tunnel": tunnel_stats(chunks),
@@ -879,6 +910,13 @@ def render_text(report):
     for phase, total_s, share in report["phase_table"]:
         pct = "  overlap" if share is None else f"{100 * share:7.1f}%"
         add(f"  {phase:<18} {total_s:10.3f} s  {pct}")
+    tail = report.get("host_tail") or {}
+    if tail.get("postsearch_share_of_collect") is not None:
+        add(f"  host tail (in collect): postsearch "
+            f"{tail['postsearch_s']:.3f} s "
+            f"({100 * tail['postsearch_share_of_collect']:.1f}% of "
+            f"collect), cluster {tail['cluster_s']:.3f} s "
+            f"({100 * tail['cluster_share_of_collect']:.1f}%)")
     add(f"  mean chunk_s {run['chunk_s']:.3f} s over "
         f"{run['nchunks']} chunk(s); bound: "
         + (", ".join(f"{k}={v}"

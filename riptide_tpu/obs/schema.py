@@ -48,13 +48,19 @@ TIMING_VERSION = 1
 PHASES = ("prep", "wire", "queue", "device", "collect", "host")
 
 # Keys of a run-level decomposition block (bench.py / stime.py).
-DECOMPOSITION_KEYS = ("prep_s", "wire_s", "device_s", "chunk_s",
-                      "wire_MBps")
+# cluster_s / postsearch_s (PR 19) total the post-pull host tail of the
+# collects — the share RIPTIDE_DEVICE_CLUSTER moves onto the device.
+DECOMPOSITION_KEYS = ("prep_s", "wire_s", "device_s", "cluster_s",
+                      "postsearch_s", "chunk_s", "wire_MBps")
 
-# Keys of a journal chunk record's `timing` block.
+# Keys of a journal chunk record's `timing` block. cluster_s and
+# postsearch_s are REPORTED sub-phases of collect_s (the clustering
+# tail and the whole post-pull host work) — like prep_s they are never
+# part of the serial wall-clock sum, which stays
+# wire_s + queue_s + collect_s + host_s == chunk_s.
 CHUNK_TIMING_KEYS = ("prep_s", "wire_s", "queue_s", "device_s",
-                     "collect_s", "host_s", "chunk_s", "wire_MBps",
-                     "bound")
+                     "collect_s", "cluster_s", "postsearch_s",
+                     "host_s", "chunk_s", "wire_MBps", "bound")
 
 # old key -> canonical key, kept readable for one release after a
 # rename. Empty today: the schema adopted the historical names.
@@ -88,18 +94,25 @@ def decomposition(summary, nchunks, elapsed):
         "prep_s": round(summary.get("prep_s", 0.0), 3),
         "wire_s": round(summary.get("wire_s", 0.0), 3),
         "device_s": round(summary.get("device_s", 0.0), 3),
+        "cluster_s": round(summary.get("cluster_s", 0.0), 3),
+        "postsearch_s": round(summary.get("postsearch_s", 0.0), 3),
         "chunk_s": round(elapsed / max(nchunks, 1), 3),
         "wire_MBps": summary.get("wire_MBps"),
     }
 
 
 def chunk_timing(chunk_s, prep_s=0.0, wire_s=0.0, queue_s=0.0,
-                 device_s=0.0, collect_s=0.0, wire_bytes=0):
+                 device_s=0.0, collect_s=0.0, cluster_s=0.0,
+                 postsearch_s=0.0, wire_bytes=0):
     """One chunk's journal ``timing`` block. ``host_s`` is the serial
     remainder (``chunk_s`` minus ship/queue/collect), clamped at zero
     against timer skew, so the serial phases always sum to the measured
     wall-clock. ``prep_s`` is reported but excluded from the sum — host
-    staging overlaps the previous chunk's device execution."""
+    staging overlaps the previous chunk's device execution — and so are
+    ``cluster_s`` / ``postsearch_s``, sub-phases already inside
+    ``collect_s`` (the clustering tail and the whole post-pull host
+    work of the collect; legacy readers simply never see the new keys,
+    nothing they consume changed)."""
     host_s = max(0.0, chunk_s - wire_s - queue_s - collect_s)
     out = {
         "prep_s": round(prep_s, 6),
@@ -107,6 +120,8 @@ def chunk_timing(chunk_s, prep_s=0.0, wire_s=0.0, queue_s=0.0,
         "queue_s": round(queue_s, 6),
         "device_s": round(device_s, 6),
         "collect_s": round(collect_s, 6),
+        "cluster_s": round(cluster_s, 6),
+        "postsearch_s": round(postsearch_s, 6),
         "host_s": round(host_s, 6),
         "chunk_s": round(chunk_s, 6),
         "bound": classify_bound(wire_s, device_s),

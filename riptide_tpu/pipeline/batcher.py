@@ -106,6 +106,10 @@ class BatchSearcher:
         # for the journal's per-chunk DQ summary. dict assignment is
         # atomic under the GIL, so loader threads may write concurrently.
         self.dq_reports = {}
+        # Zero-copy staging: wire-prep output buffers recycle across
+        # chunks through this pool (acquired in _prepare_chunk, handed
+        # back by release_chunk once a chunk's results are collected).
+        self._staging_pool = None
 
     # -- host side ----------------------------------------------------------
 
@@ -210,11 +214,14 @@ class BatchSearcher:
                         fnames
                     ))
                     items = self._prepare_chunk(tslist)
-                return shipper.submit(self._ship_spanned, items, cid)
+                return items, shipper.submit(self._ship_spanned, items, cid)
 
-            def drain(queued, t_queued, cid):
+            def drain(queued, t_queued, cid, prep_items):
                 with span("collect", chunk=cid):
                     peaks.extend(self._collect_chunk(queued))
+                # Collect done: this chunk's staging buffers are free to
+                # recycle into the pool the stager thread draws from.
+                self.release_chunk(prep_items)
                 metrics.add("chunks_done")
                 if self.watchdog is not None:
                     # Prime the liveness EWMA with this chunk's queue->
@@ -228,9 +235,10 @@ class BatchSearcher:
                        if chunks else None)
             queued = None
             t_queued = 0.0
+            q_items = None
             for i, chunk in enumerate(chunks):
                 metrics.set_gauge("queue_depth", len(chunks) - i)
-                ship_fut = pending.result()   # prep done, ship submitted
+                prep_items, ship_fut = pending.result()  # prep done
                 if i + 1 < len(chunks):
                     pending = stager.submit(stage_chunk, chunks[i + 1], i + 1)
                 items = ship_fut.result()     # wire transfer enqueued
@@ -241,14 +249,14 @@ class BatchSearcher:
                 with span("queue", chunk=i):
                     nxt = self._queue_chunk(items)
                 if queued is not None:
-                    drain(queued, t_queued, i - 1)
-                queued, t_queued = nxt, t_nxt
+                    drain(queued, t_queued, i - 1, q_items)
+                queued, t_queued, q_items = nxt, t_nxt, prep_items
                 log.debug(
                     f"Chunk {i + 1}/{len(chunks)} ({len(chunk)} files) "
                     f"queued, total peaks: {len(peaks)}"
                 )
             if queued is not None:
-                drain(queued, t_queued, len(chunks) - 1)
+                drain(queued, t_queued, len(chunks) - 1, q_items)
             metrics.set_gauge("queue_depth", 0)
         return peaks
 
@@ -277,7 +285,10 @@ class BatchSearcher:
         ``tslist`` that are None (files skipped by the ingest policy or
         series quarantined by the DQ scan) are dropped here, so both
         the stream and scheduler paths tolerate degraded chunks."""
-        from ..search.engine import prepare_stage_data
+        from ..search.engine import prepare_stage_data, _StagingPool
+
+        if self._staging_pool is None:
+            self._staging_pool = _StagingPool()
 
         tslist = [ts for ts in tslist if ts is not None]
         # Batch programs need equal-shape inputs: group by (nsamp, tsamp).
@@ -310,7 +321,9 @@ class BatchSearcher:
                     # the seeded slices prepare their own.
                     prepared = None
                 else:
-                    prepared = prepare_stage_data(plan, batch)
+                    prepared = prepare_stage_data(
+                        plan, batch, pool=self._staging_pool
+                    )
                 items.append((members, batch, conf, plan, prepared))
         return items
 
@@ -350,6 +363,25 @@ class BatchSearcher:
 
     def _collect_chunk(self, queued):
         return [p for collect in queued for p in collect()]
+
+    def release_chunk(self, items):
+        """Hand a collected chunk's wire-prep buffers back to the
+        staging pool for reuse by the next prepare. Call ONLY once the
+        chunk's results are in hand (collected and, on the journaled
+        path, recorded): the retry/shadow-probe paths re-ship from the
+        same prepared buffers, so an early release would let the stager
+        scribble over bytes a re-dispatch still needs. Items whose
+        ``prepared`` slot is not a host (flat, meta) pair — mesh-sharded
+        or HBM-seeded work — are skipped."""
+        if self._staging_pool is None or not items:
+            return
+        from ..search.engine import release_prepared
+
+        for it in items:
+            prepared = it[-1]
+            if (isinstance(prepared, tuple) and len(prepared) == 2
+                    and isinstance(prepared[1], dict)):
+                release_prepared(self._staging_pool, prepared)
 
     # -- model-seeded DM-batch pick (the jaxpr-contract HBM model) ----------
 

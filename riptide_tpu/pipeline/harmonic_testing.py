@@ -19,9 +19,11 @@ H is flagged only if ALL three distances are under their maxima.
 import logging
 from fractions import Fraction
 
+import numpy as np
+
 log = logging.getLogger("riptide_tpu.pipeline.harmonic_testing")
 
-__all__ = ["hdiag", "htest"]
+__all__ = ["hdiag", "htest", "dm_distance_matrix"]
 
 # Dispersion delay constant in s MHz^2 pc^-1 cm^3 (delay = KDM_S * DM / f^2)
 KDM_S = 4.15e3
@@ -70,6 +72,27 @@ def hdiag(F, H, tobs, fmin, fmax, denom_max=100):
         "harmonic_snr_expected": harmonic_snr_expected,
         "snr_distance": snr_distance,
     }
+
+
+def dm_distance_matrix(peaks, fmin, fmax):
+    """Pairwise :func:`hdiag` ``dm_distance`` over a peak sequence, as
+    an (n, n) float64 matrix. The DM distance is the only one of the
+    three htest criteria that does not depend on the fitted fraction,
+    so it prefilters the O(n^2) pair loop: a pair whose entry exceeds
+    ``dm_distance_max`` is rejected by :func:`htest` no matter what
+    fraction fits, and skipping it cannot change which later pairs the
+    sequential flagging pass visits (only *related* pairs mutate state).
+    Every elementwise operation mirrors the scalar expression in
+    :func:`hdiag` in the same order, so the entries are bit-identical
+    to the scalar path and the prefilter never flips a verdict."""
+    if not fmax > fmin:
+        raise ValueError("fmax must be > fmin")
+    dms = np.asarray([p.dm for p in peaks], dtype=np.float64)
+    widths = np.asarray([p.ducy / p.freq for p in peaks],
+                        dtype=np.float64)
+    band = abs(fmin**-2 - fmax**-2)
+    dm_delay = np.abs(dms[:, None] - dms[None, :]) * KDM_S * band
+    return dm_delay / np.minimum(widths[:, None], widths[None, :])
 
 
 def htest(F, H, tobs, fmin, fmax, denom_max=100, phase_distance_max=1.0,

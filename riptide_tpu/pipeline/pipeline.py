@@ -35,7 +35,7 @@ from ..utils import envflags
 from .batcher import BatchSearcher
 from .config_validation import validate_pipeline_config, validate_ranges
 from .dmiter import DMIterator
-from .harmonic_testing import htest
+from .harmonic_testing import htest, dm_distance_matrix
 from .peak_cluster import PeakCluster, clusters_to_dataframe
 
 log = logging.getLogger("riptide_tpu.pipeline")
@@ -336,8 +336,19 @@ class Pipeline:
         for rank, cl in enumerate(by_snr):
             cl.rank = rank
 
-        for F, H in itertools.combinations(by_snr, 2):
+        # DM-distance prefilter: of htest's three criteria only the DM
+        # one is fraction-free, so its pairwise matrix (bit-identical
+        # to the scalar path, see dm_distance_matrix) rejects most of
+        # the O(n^2) pairs before paying a Fraction fit each. Skipped
+        # pairs are exactly pairs htest returns related=False for, and
+        # unrelated pairs never mutate flagging state, so the flagged
+        # set is byte-identical with or without the prefilter.
+        dmat = dm_distance_matrix([cl.centre for cl in by_snr], fmin, fmax)
+        dm_max = kwargs.get("dm_distance_max", 3.0)
+        for (i, F), (j, H) in itertools.combinations(enumerate(by_snr), 2):
             if F.is_harmonic or H.is_harmonic:
+                continue
+            if dmat[i, j] > dm_max:
                 continue
             related, fraction = htest(F.centre, H.centre, tobs, fmin, fmax, **kwargs)
             if related:

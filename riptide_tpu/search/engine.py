@@ -47,6 +47,7 @@ __all__ = ["run_periodogram", "run_periodogram_batch", "run_search_batch",
            "cycle_fn", "is_oom_error", "is_timeout_error",
            "device_fingerprint", "device_peak_bytes",
            "staged_stage_programs", "staged_chunk_program",
+           "staged_peak_program",
            "staged_wire_operands", "wire_transfer_contract"]
 
 
@@ -234,20 +235,33 @@ def _ds_pack(plan):
     return pk
 
 
-def _host_downsample_all(plan, batch, wire):
+def _prep_nthreads():
+    """Worker-thread count for the native wire-prep runtime, from
+    ``RIPTIDE_PREP_THREADS`` (> 0 pins the pool size; 0/unset returns
+    None so the native wrapper applies its every-core default). The
+    pool's (stage, trial) jobs write disjoint output regions, so wire
+    bytes are identical at ANY value — the flag is a pure throughput
+    knob (and is excluded from the ledger envflag fingerprint for
+    exactly that reason)."""
+    n = int(envflags.get("RIPTIDE_PREP_THREADS"))
+    return n if n > 0 else None
+
+
+def _host_downsample_all(plan, batch, wire, out=None):
     """
     Every cascade stage's downsampling of a (D, N) batch, as one
     (S, D, nout) array in the wire dtype. Uses the native threaded
     runtime when available (this is several seconds of gather-bound
     numpy per 8-trial 2^23 batch otherwise — the single largest host
-    cost of a search).
+    cost of a search). ``out`` recycles a staging buffer.
     """
     from .. import native
 
     if native.available():
         imin, imax, wmin, wmax, wint = _ds_pack(plan)
         return native.downsample_stages(
-            batch, imin, imax, wmin, wmax, wint, dtype=wire
+            batch, imin, imax, wmin, wmax, wint, dtype=wire,
+            nthreads=_prep_nthreads(), out=out,
         )
     d64, c32, anchors = _prefix_anchored(batch)
     return np.stack(
@@ -255,18 +269,77 @@ def _host_downsample_all(plan, batch, wire):
     )
 
 
+class _StagingPool:
+    """Recyclable host staging buffers for wire prep (zero-copy in the
+    steady state: after the first chunk, prep writes into buffers the
+    previous chunk released instead of paying a multi-MB allocation +
+    page-fault pass per chunk). Thread-safe; keyed by (shape, dtype) so
+    a survey mixing batch geometries degrades to per-geometry pools.
+    Discipline: acquire inside prep, release only after the chunk's
+    results are safely collected (the shipped jnp buffers are copies,
+    but releasing early would let chunk i+1's prep race a retry
+    re-ship of chunk i — the wire digest would catch it, so this is
+    belt-and-braces, not a correctness dependency)."""
+
+    def __init__(self, max_per_key=4):
+        import threading
+
+        self._lock = threading.Lock()
+        self._free = {}
+        self._max = int(max_per_key)
+
+    def acquire(self, shape, dtype):
+        """A free buffer of exactly (shape, dtype), or None (caller
+        allocates fresh — never blocks, never fails)."""
+        key = (tuple(int(s) for s in shape), np.dtype(dtype).str)
+        with self._lock:
+            stack = self._free.get(key)
+            if stack:
+                return stack.pop()
+        return None
+
+    def release(self, buf):
+        """Return a buffer for reuse; silently drops non-arrays, views
+        and overflow beyond max_per_key (an unreleased or dropped
+        buffer just means the next acquire allocates fresh)."""
+        if not isinstance(buf, np.ndarray) or buf.base is not None:
+            return
+        key = (tuple(int(s) for s in buf.shape), buf.dtype.str)
+        with self._lock:
+            stack = self._free.setdefault(key, [])
+            if len(stack) < self._max:
+                stack.append(buf)
+
+
+def release_prepared(pool, prepared):
+    """Return a :func:`prepare_stage_data` result's staging buffers to
+    ``pool`` once the chunk's results are collected. No-op when either
+    is None (pooling is strictly optional)."""
+    if pool is None or prepared is None:
+        return
+    flat, meta = prepared
+    pool.release(flat)
+    if meta.get("scales") is not None:
+        pool.release(meta["scales"])
+
+
 def _peak_plan(plan, tobs, **peak_kwargs):
     """Per-plan cached PeakPlan (shared by the unsharded and sharded
-    survey paths so identical inputs reuse one plan)."""
-    from .peaks_device import PeakPlan
+    survey paths so identical inputs reuse one plan). The resolved
+    RIPTIDE_DEVICE_CLUSTER value joins the key: flipping the flag
+    mid-process (tests do) must rebuild the fused program rather than
+    reuse one traced under the other setting."""
+    from .peaks_device import PeakPlan, device_cluster_enabled
 
-    key = (float(tobs), tuple(sorted(peak_kwargs.items())))
+    dc = device_cluster_enabled()
+    key = (float(tobs), dc, tuple(sorted(peak_kwargs.items())))
     cache = getattr(plan, "_peak_plans", None)
     if cache is None:
         cache = plan._peak_plans = {}
     pp = cache.get(key)
     if pp is None:
-        pp = cache[key] = PeakPlan(plan, tobs, **peak_kwargs)
+        pp = cache[key] = PeakPlan(plan, tobs, device_cluster=dc,
+                                   **peak_kwargs)
     return pp
 
 
@@ -486,11 +559,13 @@ def _stage_unpack(meta, i, flat, scales, n, nout=None):
     return xd
 
 
-def _prepare_uint(plan, batch, mode):
+def _prepare_uint(plan, batch, mode, out=None, scales=None):
     """Quantised wire preparation in the kernel-decodable byte-plane
     view (:func:`_view_layout`): native single-pass when available,
     vectorised numpy otherwise (bit-identical — same float64
     downsampling, same float32 reciprocal, same round-half-even).
+    ``out``/``scales`` recycle staging buffers (re-initialised inside
+    the native wrapper, so recycled bytes are identical to fresh).
     Returns (wire (D, tot_rows, PW) uint8, scales (D, stot) f32)."""
     from .. import native
 
@@ -504,6 +579,7 @@ def _prepare_uint(plan, batch, mode):
         return native.prepare_wire_view(
             batch, imin, imax, wmin, wmax, wint, nouts, mode, PW,
             vl["roffs"], vl["tot_rows"], vl["soffs"], vl["stot"],
+            nthreads=_prep_nthreads(), out=out, scales=scales,
         )
     d64, c32, anchors = _prefix_anchored(batch)
     out = np.zeros((D, vl["tot_rows"], PW), np.uint8)
@@ -965,16 +1041,19 @@ def _assemble_device(plan, layout, *outs):
     return jnp.concatenate(chunks, axis=1)
 
 
-def prepare_stage_data(plan, batch, mode=None):
+def prepare_stage_data(plan, batch, mode=None, pool=None):
     """
     HOST half of a batched search: every cascade stage's downsampling of
     the (D, N) batch, concatenated unpadded into ONE flat wire buffer in
     the transport of :func:`_wire_mode` (8-bit block-scaled by default on the
     kernel path). Ships to the device as a single transfer — per-stage
     transfers each pay the interconnect round-trip latency. Runs in the
-    native threaded runtime when available; callers can invoke this on a
-    worker thread to overlap the next batch's host work with device
-    execution of the current one (ctypes releases the GIL).
+    native threaded runtime when available (RIPTIDE_PREP_THREADS cores);
+    callers can invoke this on a worker thread to overlap the next
+    batch's host work with device execution of the current one (ctypes
+    releases the GIL). ``pool`` (a :class:`_StagingPool`) recycles the
+    output staging buffers across chunks — callers hand them back with
+    :func:`release_prepared` once the chunk's results are collected.
 
     Returns ``(flat, meta)`` where meta carries the path, wire mode,
     per-stage offsets/lengths and (uint8/uint6/uint12) quantisation
@@ -986,19 +1065,28 @@ def prepare_stage_data(plan, batch, mode=None):
     t0 = time.perf_counter()
     path = _ffa_path()
     mode = mode or _wire_mode(path)
+    D = batch.shape[0]
     with span("prep", mode=mode):
         offs, lens, tot = _wire_layout(plan, mode)
         scales = None
         if mode in _WIRE_Q:
-            flat, scales = _prepare_uint(plan, batch, mode)
+            vl = _view_layout(plan, mode)
+            sout = sscales = None
+            if pool is not None:
+                sout = pool.acquire((D, vl["tot_rows"], vl["PW"]),
+                                    np.uint8)
+                sscales = pool.acquire((D, vl["stot"]), np.float32)
+            flat, scales = _prepare_uint(plan, batch, mode, out=sout,
+                                         scales=sscales)
             meta = {"path": path, "mode": mode, "offs": offs,
-                    "lens": lens, "scales": scales,
-                    "view": _view_layout(plan, mode)}
+                    "lens": lens, "scales": scales, "view": vl}
         else:
             wire = np.dtype(mode)
             xds = _host_downsample_all(plan, batch, wire)
-            D = batch.shape[0]
-            flat = np.empty((D, tot), wire)
+            flat = pool.acquire((D, tot), wire) if pool is not None \
+                else None
+            if flat is None:
+                flat = np.empty((D, tot), wire)
             for i, st in enumerate(plan.stages):
                 flat[:, offs[i] : offs[i] + st.n] = xds[i][..., : st.n]
             meta = {"path": path, "mode": mode, "offs": offs,
@@ -1426,6 +1514,23 @@ def staged_chunk_program(plan, D, path=None, mode=None):
             return _assemble_device(plan, layout, *outs)
         args = tuple(parts)
     return fn, args
+
+
+def staged_peak_program(plan, D, tobs=600.0, **peak_kwargs):
+    """The fused peak-detection program of a D-trial chunk as a
+    traceable ``(fn, args, peak_plan)`` triple over the abstract
+    (D, n_trials, NW) S/N cube — the contract tooling's hook for the
+    post-search tail. With RIPTIDE_DEVICE_CLUSTER on, the SAME single
+    program additionally carries the on-device clustering + harmonic
+    screen (never an extra dispatch); the returned plan's
+    ``device_cluster`` says which form was traced."""
+    pp = _peak_plan(plan, tobs, **peak_kwargs)
+    snr = jax.ShapeDtypeStruct((D, pp.n, len(plan.widths)), jnp.float32)
+
+    def fn(s):
+        return pp._fused(s)
+
+    return fn, (snr,), pp
 
 
 def wire_transfer_contract(plan, mode):

@@ -29,9 +29,27 @@ post-search latency:
 Columns with more than CAP non-empty blocks (pathological thresholds)
 fall back to the round-trip block gather for the overflow blocks, so
 every selected point still reaches the host.
-"""
-import logging
 
+On-device clustering (RIPTIDE_DEVICE_CLUSTER, default on) additionally
+runs the reference's 1-D friends-of-friends clustering INSIDE the fused
+program: segment heads/tails from a host-precomputed exact-float64
+``reach`` table, per-cluster running (S/N, index) lexmax via a
+segmented ``associative_scan``, and top_k compaction of up to
+``REP_CAP`` cluster representatives per (trial, width) column — plus an
+advisory per-trial harmonic screen over the representatives. The pull
+then carries both the representative sections AND the block sections,
+and the host keeps a column's device representatives only when it can
+PROVE them equal to its own float64 tail (no threshold-marginal points,
+cluster count within REP_CAP, and an exact bound on the float32-vs-
+float64 threshold polynomial difference below the EPS margin);
+otherwise that column falls back to the block data already in hand —
+peaks are bit-identical to the host path in every case, flag on or off.
+"""
+import contextlib
+import logging
+import time
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 from functools import partial
@@ -39,18 +57,63 @@ from functools import partial
 from ..clustering import cluster1d
 from ..obs.trace import span
 from ..survey.integrity import fold_result
+from ..survey.metrics import get_metrics
+from ..utils import envflags
 from ..utils.exec_cache import cached_jit
 from ..peak_detection import Peak, fit_threshold
 
 log = logging.getLogger("riptide_tpu.peaks_device")
 
 __all__ = ["PeakPlan", "device_find_peaks", "queue_find_peaks",
-           "collect_peaks"]
+           "collect_peaks", "device_cluster_enabled",
+           "force_device_cluster"]
 
 # Margin (in S/N units) by which the device-side threshold is lowered;
 # marginal points are re-judged on host in float64. Device f32 rounding
 # of the threshold polynomial is ~1e-5 relative; 1e-2 absolute is safe.
 EPS = 1e-2
+
+# float32 unit roundoff, for the host-side proof that the device's f32
+# threshold evaluation stays inside the EPS margin (see _trusted_cols).
+_EPS32 = float(np.finfo(np.float32).eps)
+
+# Harmonic-screen maxima mirroring htest's defaults and its q <= 100
+# fraction search (pipeline/harmonic_testing.py) — the screen is
+# ADVISORY (a metrics counter, never a CSV field), so these are not
+# plumbed through config.
+_H_QMAX = 100
+_H_PHASE_MAX = 1.0
+_H_SNR_MAX = 3.0
+
+
+# Pinned override of the flag (see force_device_cluster); None defers
+# to the environment.
+_DC_OVERRIDE = None
+
+
+def device_cluster_enabled():
+    """Resolved RIPTIDE_DEVICE_CLUSTER: run clustering + the harmonic
+    screen inside the fused device program (the host still verifies and
+    falls back per column, so the flag changes WHERE the tail runs,
+    never what comes out)."""
+    if _DC_OVERRIDE is not None:
+        return _DC_OVERRIDE
+    return bool(envflags.get("RIPTIDE_DEVICE_CLUSTER"))
+
+
+@contextlib.contextmanager
+def force_device_cluster(value):
+    """Pin :func:`device_cluster_enabled` to ``value`` for the block,
+    overriding the environment. Used by the integrity canary, whose
+    pinned digest covers the pulled buffer LAYOUT and must therefore
+    not follow a run's flag override."""
+    global _DC_OVERRIDE
+    prev = _DC_OVERRIDE
+    _DC_OVERRIDE = bool(value)
+    try:
+        yield
+    finally:
+        _DC_OVERRIDE = prev
 
 
 class PeakPlan:
@@ -58,7 +121,7 @@ class PeakPlan:
     periodogram plan + observation length."""
 
     def __init__(self, plan, tobs, smin=6.0, segwidth=5.0, nstd=6.0,
-                 minseg=10, polydeg=2, clrad=0.1):
+                 minseg=10, polydeg=2, clrad=0.1, device_cluster=None):
         freqs = 1.0 / plan.all_periods  # decreasing, like Periodogram.freqs
         n = freqs.size
         w = segwidth / tobs
@@ -86,11 +149,56 @@ class PeakPlan:
         # float64 np.polyfit re-fit happens on host in _finalize.
         V = np.vander(np.log(self.fc), self.polydeg + 1)
         self.fitmat = (np.linalg.inv(V.T @ V) @ V.T).astype(np.float32)
+        if device_cluster is None:
+            device_cluster = device_cluster_enabled()
+        # The trusted fast path needs exact-maximisable threshold
+        # difference polynomials (deg <= 2) and exact trial indices in
+        # float32 (n < 2^24); outside those bounds the device sections
+        # would never be trusted, so don't build them at all.
+        self.device_cluster = bool(device_cluster) \
+            and self.polydeg <= 2 and n < (1 << 24)
+        if self.device_cluster:
+            self.reach = self._cluster_reach()
+            self.freqs_f32 = freqs.astype(np.float32)
+            self.foldbins_f32 = np.asarray(plan.all_foldbins,
+                                           np.float32)
+            self.widths_f32 = np.asarray(plan.widths, np.float32)
         # Stable identity for the cross-process executable cache.
         self.cache_token = ("peak_plan", getattr(plan, "cache_token", None),
                             self.tobs, self.smin, self.nstd, self.minseg,
                             self.polydeg, self.clrad, nseg, pts,
-                            self.BLK, self.CAP)
+                            self.BLK, self.CAP,
+                            self.device_cluster, self.REP_CAP)
+
+    def _cluster_reach(self):
+        """reach[a] = largest trial index j >= a still within the
+        clustering radius of trial a, under cluster1d's EXACT float64
+        predicate ``fl(freqs[a] - freqs[j]) <= r`` (freqs decrease with
+        trial index, so the subtraction is the gap between trial a and
+        every later trial). NOT the algebraically equivalent
+        ``freqs[j] >= freqs[a] - r``: the two round differently, and the
+        device cluster boundaries must reproduce cluster1d's decisions
+        bit-for-bit. A searchsorted guess under the rearranged predicate
+        lands within a few ulp-indices of the exact answer; the fix-up
+        loops below walk it to the exact fixed point (the predicate is
+        monotone in j, so each loop converges)."""
+        freqs, n = self.freqs, self.n
+        r = self.clrad / self.tobs
+        j = np.searchsorted(-freqs, -(freqs - r), side="right") - 1
+        j = np.clip(j, np.arange(n), n - 1)
+        a = np.arange(n)
+        while True:
+            bad = freqs[a] - freqs[j] > r
+            if not bad.any():
+                break
+            j[bad] -= 1
+        while True:
+            grow = (j + 1 < n) & (freqs[a] - freqs[np.minimum(j + 1, n - 1)]
+                                  <= r)
+            if not grow.any():
+                break
+            j[grow] += 1
+        return j.astype(np.int32)
 
     # -- step 1: device segment stats ------------------------------------
 
@@ -146,23 +254,146 @@ class PeakPlan:
     # overflow fallback (extra round-trip gather) covers pathological
     # thresholds.
     CAP = 8
+    # Cluster representatives carried home per (trial, width) column by
+    # the on-device clustering; a column with more clusters (threshold
+    # pathologically low) is never trusted and falls back to the block
+    # data in the same pull.
+    REP_CAP = 32
 
     @property
     def _nb(self):
         return -(-self.n // self.BLK)
 
-    def _counts_impl(self, snr, polyco):
+    def _thr_impl(self, polyco):
+        """Horner evaluation of the f32 threshold polynomial at every
+        trial's log-frequency: (D, NW, deg+1) -> (D, NW, n)."""
         logf = jnp.asarray(self.logf)
-        # Horner evaluation of the threshold polynomial at every trial.
         thr = jnp.zeros(polyco.shape[:2] + (self.n,), jnp.float32)
         for k in range(polyco.shape[-1]):
             thr = thr * logf[None, None, :] + polyco[:, :, k, None]
+        return thr
+
+    def _counts_impl(self, snr, polyco):
+        thr = self._thr_impl(polyco)
         s = snr.transpose(0, 2, 1)  # (D, NW, n)
         mask = (s > thr - EPS) & (s > self.smin - EPS)
         D, NW, n = s.shape
         pad = self._nb * self.BLK - n
         mask = jnp.pad(mask, [(0, 0), (0, 0), (0, pad)])
         return mask.reshape(D, NW, self._nb, self.BLK).sum(-1).astype(jnp.int32)
+
+    # -- on-device 1-D clustering over the sure-selected mask ------------
+
+    def _cluster_impl(self, s, thr):
+        """Friends-of-friends clustering of each (trial, width) column's
+        SURE points (above threshold + EPS: provably selected by the
+        host's exact float64 cut whenever the column's threshold
+        difference bound holds — see _trusted_cols). Returns
+        (ncl (D,NW) int32, marg (D,NW) bool,
+         rep_idx / rep_val (D,NW,REP_CAP)): per-cluster lexmax-(S/N,
+        trial index) representatives in ascending-trial (= descending
+        frequency = ascending cluster id) slot order.
+
+        Cluster boundaries reproduce cluster1d exactly: adjacent
+        selected trials j_prev < j chain iff j <= reach[j_prev], the
+        host-precomputed exact-float64 radius predicate. Heads/tails
+        come from running prev/next-selected-index scans (cummax /
+        reversed cummin); the per-cluster running lexmax is a segmented
+        associative_scan reset at heads, so the whole thing stays
+        O(n log n) with fixed shapes — no sort, no scatter."""
+        m_sel = (s > thr - EPS) & (s > self.smin - EPS)
+        m = (s > thr + EPS) & (s > self.smin + EPS)        # sure
+        marg = jnp.any(m_sel & ~m, axis=-1)                # (D, NW)
+        n = self.n
+        reach = jnp.asarray(self.reach)
+        idx = jnp.arange(n, dtype=jnp.int32)
+        midx = jnp.broadcast_to(idx, m.shape)
+        # prev_excl[j] / next_excl[j]: nearest selected index strictly
+        # before / after j (-1 / n when none).
+        prev = jax.lax.cummax(jnp.where(m, midx, -1), axis=2)
+        prev_excl = jnp.pad(prev[..., :-1], [(0, 0), (0, 0), (1, 0)],
+                            constant_values=-1)
+        nxt = jax.lax.cummin(jnp.where(m, midx, n), axis=2, reverse=True)
+        next_excl = jnp.pad(nxt[..., 1:], [(0, 0), (0, 0), (0, 1)],
+                            constant_values=n)
+        reach_prev = reach[jnp.clip(prev_excl, 0, n - 1)]
+        head = m & ((prev_excl < 0) | (midx > reach_prev))
+        last = m & (next_excl > reach[idx][None, None, :])
+        ncl = head.sum(-1).astype(jnp.int32)
+
+        # Segmented forward lexmax over (S/N, trial index), reset at
+        # heads; ties take the LARGER index — the host argmax over the
+        # descending-trial cluster array picks exactly that point.
+        def comb(a, b):
+            fa, va, ia = a
+            fb, vb, ib = b
+            take_b = (vb > va) | ((vb == va) & (ib > ia))
+            v = jnp.where(fb, vb, jnp.where(take_b, vb, va))
+            i = jnp.where(fb, ib, jnp.where(take_b, ib, ia))
+            return fb | fa, v, i
+
+        _, scan_v, scan_i = jax.lax.associative_scan(
+            comb,
+            (head, jnp.where(m, s, -jnp.inf),
+             jnp.where(m, midx, -1)),
+            axis=-1,
+        )
+        # Compact the first REP_CAP tail positions per column: strictly
+        # decreasing keys REP_CAP..1 at kept tails, 0 elsewhere, so
+        # top_k returns them in ascending-trial order.
+        rank = jnp.cumsum(last.astype(jnp.int32), axis=-1,
+                          dtype=jnp.int32) - 1
+        keep = last & (rank < self.REP_CAP)
+        key = jnp.where(keep, (self.REP_CAP - rank).astype(jnp.float32),
+                        0.0)
+        kv, pos = jax.lax.top_k(key, self.REP_CAP)
+        valid = kv > 0
+        rep_val = jnp.take_along_axis(scan_v, pos, axis=-1)
+        rep_idx = jnp.where(valid,
+                            jnp.take_along_axis(scan_i, pos, axis=-1), -1)
+        rep_val = jnp.where(valid, rep_val, -jnp.inf)
+        return ncl, marg, rep_idx, rep_val
+
+    def _harm_impl(self, rep_idx, rep_val):
+        """Advisory per-trial harmonic screen over the cluster
+        representatives: for each DM row, count representatives whose
+        phase drift against the row's brightest representative matches
+        a p/q rational (q <= 100, htest's cap) within the pulse width
+        AND whose S/N matches the expected harmonic loss — htest's
+        phase + S/N distances (the DM distance is identically zero
+        within one DM row). float32, counts only — never a CSV field.
+        Returns (D,) float32 counts."""
+        D = rep_idx.shape[0]
+        R = rep_idx.shape[1] * rep_idx.shape[2]
+        ridx = rep_idx.reshape(D, R)
+        rval = rep_val.reshape(D, R)
+        valid = ridx >= 0
+        safe = jnp.clip(ridx, 0, self.n - 1)
+        freq = jnp.asarray(self.freqs_f32)[safe]
+        ducy = (jnp.repeat(jnp.asarray(self.widths_f32),
+                           rep_idx.shape[2])[None, :]
+                / jnp.asarray(self.foldbins_f32)[safe])
+        top = jnp.argmax(jnp.where(valid, rval, -jnp.inf), axis=-1)
+        fF = jnp.take_along_axis(freq, top[:, None], axis=-1)
+        sF = jnp.take_along_axis(rval, top[:, None], axis=-1)
+        dF = jnp.take_along_axis(ducy, top[:, None], axis=-1)
+        lo = jnp.minimum(freq, fF)
+        hi = jnp.maximum(freq, fF)
+        ducy_fast = jnp.where(freq >= fF, ducy, dF)
+        ratio = hi / jnp.maximum(lo, 1e-30)
+        q = jnp.arange(1, _H_QMAX + 1, dtype=jnp.float32)
+        p = jnp.maximum(jnp.round(ratio[..., None] * q), 1.0)
+        err = jnp.abs(ratio[..., None] - p / q)
+        best = jnp.argmin(err, axis=-1)
+        err_b = jnp.take_along_axis(err, best[..., None], -1)[..., 0]
+        pq = jnp.take_along_axis(p * q, best[..., None], -1)[..., 0]
+        phase = err_b * lo * self.tobs / jnp.maximum(ducy_fast, 1e-30)
+        snr_d = jnp.abs(rval - sF / jnp.sqrt(pq))
+        others = valid \
+            & (jnp.arange(R, dtype=jnp.int32)[None, :] != top[:, None]) \
+            & (jnp.any(valid, axis=-1))[:, None]
+        related = others & (phase <= _H_PHASE_MAX) & (snr_d <= _H_SNR_MAX)
+        return related.sum(-1).astype(jnp.float32)
 
     @cached_jit(static_argnames=("self",))
     def _block_counts(self, snr, polyco):
@@ -177,8 +408,14 @@ class PeakPlan:
         """The whole device side in one program: stats, f32 threshold
         fit, block counts, and compaction of the first CAP non-empty
         blocks per column. Returns ONE flat float32 buffer
-        [stats | cnt (bitcast) | ids (bitcast) | vals] so the host pays
-        a single transfer."""
+        [stats | cnt | ids | vals] so the host pays a single transfer.
+        With device clustering on, the buffer additionally carries
+        [coef | ncl | marg | rep_idx | rep_val | harm] — the f32
+        threshold coefficients (for the host's trust proof), per-column
+        cluster counts / marginal flags, the cluster representatives,
+        and the advisory per-trial harmonic-suspect counts. Still one
+        program, one pull: the flag never adds a dispatch or a
+        transfer, it only grows the one buffer by a few KB."""
         stats = self._stats_impl(snr)                   # (D, NW, nseg, 3)
         D, NW = stats.shape[:2]
         if self.nseg >= self.minseg:
@@ -211,21 +448,47 @@ class PeakPlan:
         # execution path flushes denormals to zero (observed: block ids
         # 24/38 arriving as 0 while the NaN-payload -1 survived).
         f32 = partial(jnp.asarray, dtype=jnp.float32)
-        return jnp.concatenate(
-            [stats.ravel(), f32(cnt).ravel(), f32(ids).ravel(), vals.ravel()]
-        )
+        parts = [stats.ravel(), f32(cnt).ravel(), f32(ids).ravel(),
+                 vals.ravel()]
+        if self.device_cluster:
+            s = snr.transpose(0, 2, 1)
+            thr = self._thr_impl(coef)
+            ncl, marg, rep_idx, rep_val = self._cluster_impl(s, thr)
+            harm = self._harm_impl(rep_idx, rep_val)
+            # rep_val may carry -inf in empty slots; map to 0 so the
+            # integrity digest fold never sees non-finite bytes.
+            rep_val = jnp.where(rep_idx >= 0, rep_val, 0.0)
+            parts += [coef.ravel(), f32(ncl).ravel(), f32(marg).ravel(),
+                      f32(rep_idx).ravel(), rep_val.ravel(), harm]
+        return jnp.concatenate(parts)
 
     def _unpack(self, buf, D):
         NW, nseg, nb, CAP, BLK = (len(self.plan.widths), self.nseg,
                                   self._nb, self.CAP, self.BLK)
         sizes = [D * NW * nseg * 3, D * NW * nb, D * NW * CAP,
                  D * NW * CAP * BLK]
+        if self.device_cluster:
+            RC = self.REP_CAP
+            sizes += [D * NW * (self.polydeg + 1), D * NW, D * NW,
+                      D * NW * RC, D * NW * RC, D]
         offs = np.concatenate([[0], np.cumsum(sizes, dtype=np.int64)])
         stats = buf[offs[0]:offs[1]].reshape(D, NW, nseg, 3)
         cnt = buf[offs[1]:offs[2]].astype(np.int32).reshape(D, NW, nb)
         ids = buf[offs[2]:offs[3]].astype(np.int32).reshape(D, NW, CAP)
         vals = buf[offs[3]:offs[4]].reshape(D, NW, CAP, BLK)
-        return stats, cnt, ids, vals
+        if not self.device_cluster:
+            return stats, cnt, ids, vals, None
+        RC = self.REP_CAP
+        extra = {
+            "coef": buf[offs[4]:offs[5]].reshape(D, NW, self.polydeg + 1),
+            "ncl": buf[offs[5]:offs[6]].astype(np.int32).reshape(D, NW),
+            "marg": buf[offs[6]:offs[7]].reshape(D, NW) != 0.0,
+            "rep_idx": buf[offs[7]:offs[8]].astype(np.int64).reshape(
+                D, NW, RC),
+            "rep_val": buf[offs[8]:offs[9]].reshape(D, NW, RC),
+            "harm": buf[offs[9]:offs[10]],
+        }
+        return stats, cnt, ids, vals, extra
 
     @cached_jit(static_argnames=("self",))
     def _gather_blocks(self, snr, flat_ids):
@@ -242,9 +505,65 @@ class PeakPlan:
 
     # -- step 4: host exact threshold + clustering -----------------------
 
-    def _finalize(self, cols, polyco, widths, foldbins, dms, D, NW):
+    def _trusted_cols(self, extra, polyco):
+        """(D, NW) bool: columns whose device cluster representatives
+        are PROVABLY identical to the host float64 tail's. A column is
+        trusted iff (a) no point fell in the +/-EPS marginal band (so
+        the device's sure mask IS the host's exact-keep set, given (c)),
+        (b) every cluster fit in the REP_CAP slots, and (c) the f32
+        threshold the device applied provably stays within EPS of the
+        host's float64 polynomial everywhere on the log-f domain: the
+        difference of the two polynomials has degree <= 2, so its
+        maximum over [min log f, max log f] is computed EXACTLY from
+        the endpoints and the single critical point, plus a
+        conservative bound on the device's f32 Horner evaluation
+        rounding. Never a guess — an untrusted column costs only the
+        host fallback on block data already pulled."""
+        coef = extra["coef"].astype(np.float64)            # (D, NW, K)
+        if self.nseg >= self.minseg:
+            ref = polyco
+        else:
+            ref = np.zeros_like(coef)
+            ref[..., -1] = self.smin
+        diff = coef - ref
+        logf64 = np.log(self.freqs)
+        x0, x1 = float(logf64.min()), float(logf64.max())
+        X = max(abs(x0), abs(x1))
+        K = diff.shape[-1]
+        d2 = diff.reshape(-1, K)
+
+        def horner(x):
+            r = np.zeros(d2.shape[0], np.float64)
+            for k in range(K):
+                r = r * x + d2[:, k]
+            return r
+
+        cand = [horner(x0), horner(x1)]
+        if K == 3:
+            a, b = d2[:, 0], d2[:, 1]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                xc = np.where(a != 0.0, -b / (2.0 * a), x0)
+            cand.append(horner(np.clip(xc, x0, x1)))
+        maxdiff = np.max(np.abs(np.stack(cand)), axis=0).reshape(
+            diff.shape[:2])
+        powers = X ** np.arange(K - 1, -1, -1.0)
+        mag = (np.abs(coef) * powers).sum(-1)
+        dcoef = np.abs(coef[..., :-1]) * np.arange(K - 1, 0, -1.0)
+        dmag = (dcoef * powers[1:]).sum(-1) if K > 1 else 0.0
+        slack = 64.0 * _EPS32 * (mag + dmag * X)
+        return ((maxdiff + slack < EPS) & ~extra["marg"]
+                & (extra["ncl"] <= self.REP_CAP))
+
+    def _finalize(self, cols, polyco, widths, foldbins, dms, D, NW,
+                  device_reps=None):
         """cols: dict (d, iw) -> (trial indices int64, S/N float64) of
-        every device-selected point in that column."""
+        every device-selected point in that column. ``device_reps``:
+        dict (d, iw) -> [(trial index, S/N), ...] of TRUSTED device
+        cluster representatives, already in the host's per-column
+        emission order (ascending frequency); those columns skip the
+        host re-check + clustering entirely — by the trust proof the
+        result is identical, including the insertion order the final
+        stable sort preserves among equal-S/N peaks."""
         peaks_per_trial = [[] for _ in range(D)]
         polycos = [{} for _ in range(D)]
         logf64 = np.log(self.freqs)
@@ -253,6 +572,17 @@ class PeakPlan:
                 pc = polyco[d, iw]
                 poly = np.poly1d(pc if self.nseg >= self.minseg else [self.smin])
                 polycos[d][iw] = poly.coefficients
+                if device_reps is not None and (d, iw) in device_reps:
+                    for ip, sj in device_reps[(d, iw)]:
+                        fpk = float(self.freqs[ip])
+                        peaks_per_trial[d].append(Peak(
+                            period=float(1.0 / fpk), freq=fpk,
+                            width=int(widths[iw]),
+                            ducy=float(widths[iw]) / float(foldbins[ip]),
+                            iw=int(iw), ip=int(ip), snr=float(sj),
+                            dm=float(dms[d]),
+                        ))
+                    continue
                 if (d, iw) not in cols:
                     continue
                 ix, sv = cols[(d, iw)]
@@ -285,6 +615,13 @@ def queue_find_peaks(peak_plan, snr_dev):
     handle without syncing, so callers can enqueue the NEXT batch's
     device work before paying this batch's device->host round trip."""
     snr_dev = jnp.asarray(snr_dev)
+    if peak_plan.device_cluster:
+        # The on-device clustering rides INSIDE the one fused program
+        # (never an extra dispatch); this counter is how the contract
+        # tooling and the dispatch-count regression test prove exactly
+        # one cluster program per chunk when the flag is on, zero when
+        # off.
+        get_metrics().add("dispatch_cluster", 1)
     # A mutable handle: collect_peaks nulls the entries to release the
     # device buffers even while the caller still holds the handle
     # (queue-ahead pipelining keeps two batches' handles live at once).
@@ -308,15 +645,47 @@ def collect_peaks(peak_plan, handle, dms):
     # ``buf`` untouched when no fold context is active).
     buf = fold_result(buf)
     handle[0] = buf_dev = None
-    stats, cnt, ids, vals = peak_plan._unpack(buf, D)
-    # The S/N cube is only needed again for the (pathological) overflow
-    # gather below; release it as soon as the counts show no column
-    # overflowed its CAP-block budget.
-    if not ((cnt > 0).sum(axis=2) > peak_plan.CAP).any():
-        handle[1] = snr_dev = None
+    t_host = time.perf_counter()   # the host tail starts after the pull
+    reg = get_metrics()
+    stats, cnt, ids, vals, extra = peak_plan._unpack(buf, D)
     NW, nb, BLK, CAP = (cnt.shape[1], peak_plan._nb, peak_plan.BLK,
                         peak_plan.CAP)
     polyco = peak_plan._fit(stats)
+
+    # On-device clustering: keep a column's device representatives only
+    # when the trust proof holds (see _trusted_cols); untrusted columns
+    # fall back to the block data already in this pull — no extra
+    # round trip, bit-identical output either way.
+    trusted = None
+    device_reps = None
+    if extra is not None:
+        trusted = peak_plan._trusted_cols(extra, polyco)
+        ncl, rep_idx, rep_val = (extra["ncl"], extra["rep_idx"],
+                                 extra["rep_val"])
+        device_reps = {}
+        for d, iw in zip(*np.nonzero(trusted & (ncl > 0))):
+            k = int(ncl[d, iw])
+            # Representative slots are in ascending-trial (descending
+            # frequency) order; the host emits clusters in ascending
+            # FREQUENCY order, so walk them reversed — the final stable
+            # sort preserves this order among equal-S/N peaks.
+            device_reps[(int(d), int(iw))] = [
+                (int(rep_idx[d, iw, c]), float(rep_val[d, iw, c]))
+                for c in reversed(range(k))
+            ]
+        reg.add("cluster_cols_device", int((trusted & (ncl > 0)).sum()))
+        reg.add("harmonic_suspects", int(extra["harm"].sum()))
+
+    # The S/N cube is only needed again for the (pathological) overflow
+    # gather below; release it as soon as the counts show no UNTRUSTED
+    # column overflowed its CAP-block budget (a trusted column's
+    # overflow blocks are irrelevant — its peaks come from the
+    # representative section).
+    over_mask = (cnt > 0).sum(axis=2) > CAP
+    if trusted is not None:
+        over_mask &= ~trusted
+    if not over_mask.any():
+        handle[1] = snr_dev = None
     off = np.arange(BLK)
     cols = {}
 
@@ -334,16 +703,21 @@ def collect_peaks(peak_plan, handle, dms):
             cols[key] = (ix, sv)
 
     for d, iw in zip(*np.nonzero((ids >= 0).any(axis=2))):
+        if trusted is not None and trusted[d, iw]:
+            continue
         for c in range(CAP):
             b = ids[d, iw, c]
             if b < 0:
                 break
             add(d, iw, b, vals[d, iw, c])
+    if trusted is not None:
+        reg.add("cluster_cols_host", len(cols))
 
-    # Overflow: a column with more than CAP non-empty blocks (threshold
-    # pathologically low) falls back to the round-trip bucketed gather
-    # for the blocks the fused program could not carry home.
-    over = np.argwhere((cnt > 0).sum(axis=2) > CAP)
+    # Overflow: an untrusted column with more than CAP non-empty blocks
+    # (threshold pathologically low) falls back to the round-trip
+    # bucketed gather for the blocks the fused program could not carry
+    # home.
+    over = np.argwhere(over_mask)
     if over.size:
         sel = []
         for d, iw in over:
@@ -369,12 +743,22 @@ def collect_peaks(peak_plan, handle, dms):
             add(d, iw, b, row)
 
     # Host tail of the collect: exact float64 threshold re-check +
-    # friends-of-friends clustering (ROADMAP item 5 targets exactly
-    # this span, so it must be separable from the device wait above).
+    # friends-of-friends clustering for the untrusted columns, direct
+    # Peak assembly from the device representatives for the trusted
+    # ones (ROADMAP item 5 targets exactly this span, so it must be
+    # separable from the device wait above). cluster_s times just this
+    # tail; postsearch_s the whole post-pull host work — both are
+    # REPORTED chunk-timing keys, already covered by collect_s in the
+    # serial phase sum.
     with span("cluster", trials=int(D)):
-        return peak_plan._finalize(
-            cols, polyco, plan.widths, plan.all_foldbins, dms, D, NW
+        t_cl = time.perf_counter()
+        out = peak_plan._finalize(
+            cols, polyco, plan.widths, plan.all_foldbins, dms, D, NW,
+            device_reps=device_reps,
         )
+        reg.observe("cluster_s", time.perf_counter() - t_cl)
+    reg.observe("postsearch_s", time.perf_counter() - t_host)
+    return out
 
 
 def device_find_peaks(peak_plan, snr_dev, dms):

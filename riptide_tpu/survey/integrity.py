@@ -312,8 +312,14 @@ def compute_canary_digest():
     """Run the pinned-input canary search through the real collect
     path and return its collected-buffer digest (hex). Deterministic
     per platform: explicit rng seed, fixed plan geometry, and the fold
-    covers the exact bytes the device handed back."""
+    covers the exact bytes the device handed back. The canary runs
+    under DEFAULT ``RIPTIDE_DEVICE_CLUSTER`` semantics regardless of
+    the surrounding run's setting — the flag changes the pulled
+    buffer's layout (the on-device cluster sections ride along), and
+    the canary exists to catch a device computing a KNOWN-good input
+    wrongly, not a configuration override."""
     from ..search.engine import run_search_batch
+    from ..search.peaks_device import force_device_cluster
     from ..search.plan import periodogram_plan
 
     plan = periodogram_plan(
@@ -327,8 +333,10 @@ def compute_canary_digest():
     prev = _active()
     _tls.acc = acc
     try:
-        run_search_batch(plan, batch, CANARY_NSAMP * CANARY_TSAMP,
-                         dms=np.arange(CANARY_TRIALS, dtype=np.float64))
+        with force_device_cluster(True):
+            run_search_batch(
+                plan, batch, CANARY_NSAMP * CANARY_TSAMP,
+                dms=np.arange(CANARY_TRIALS, dtype=np.float64))
     finally:
         _tls.acc = prev
     return acc.hexdigest()

@@ -467,6 +467,8 @@ class SurveyScheduler:
             )
         m = self.metrics
         dev0 = m.timer_total("device_s")
+        cl0 = m.timer_total("cluster_s")
+        ps0 = m.timer_total("postsearch_s")
         wb0 = m.counter("wire_bytes")
         acc = None
         if self.integrity is not None:
@@ -499,6 +501,13 @@ class SurveyScheduler:
             "queue_s": t2 - t1,
             "collect_s": collect_s,
             "device_s": min(m.timer_total("device_s") - dev0, collect_s),
+            # Host-tail sub-phases of the collect (engine-recorded, read
+            # as deltas like device_s): the clustering tail and the
+            # whole post-pull host work — the share the on-device
+            # clustering flag moves off the host.
+            "cluster_s": min(m.timer_total("cluster_s") - cl0, collect_s),
+            "postsearch_s": min(m.timer_total("postsearch_s") - ps0,
+                                collect_s),
             "wire_bytes": int(m.counter("wire_bytes") - wb0),
         }
         return peaks, parts, rinfo
@@ -1072,6 +1081,12 @@ class SurveyScheduler:
                                 timings=timing, attempts=attempts, dq=dq,
                                 hbm=hbm, extra=extra or None,
                             )
+                    # Results recorded: the chunk's wire-prep buffers can
+                    # recycle into the staging pool. Never earlier — the
+                    # retry and shadow-probe paths above re-ship from the
+                    # same prepared buffers.
+                    if hasattr(self.searcher, "release_chunk"):
+                        self.searcher.release_chunk(items)
                     # Per-chunk fleet publication + live alert evaluation
                     # (both no-ops while their flags are off, both
                     # never-fatal): the measure→detect half of the loop.

@@ -386,6 +386,30 @@ _ALL = [
         choices=("off", "digest", "probe", "strict"),
     ),
     EnvFlag(
+        "RIPTIDE_DEVICE_CLUSTER", "bool", True,
+        "Run 1-D peak clustering (and the advisory harmonic screen) on "
+        "device inside the fused peak program: cluster representatives "
+        "come home in the single result pull and the host skips the "
+        "per-point float64 re-check + friends-of-friends loop for "
+        "every column the exact-parity guards accept (marginal-band "
+        "points, representative overflow or a float32-threshold drift "
+        "beyond EPS fall back per column to the host path, which stays "
+        "bit-identical). `0` reverts to the pure host tail — peaks.csv "
+        "and candidates.csv are byte-identical either way.",
+        since="PR 19 (0.18.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_PREP_THREADS", "int", 0,
+        "Worker threads of the native wire-prep runtime (downsample + "
+        "quantise). `0` (default) uses every core (capped at 32); a "
+        "positive value pins the count, e.g. `1` for single-core "
+        "baselines. Pure throughput knob: the native job pool assigns "
+        "disjoint output regions per (stage, trial) job, so wire bytes "
+        "and digests are identical at any thread count (excluded from "
+        "the ledger envflag fingerprint for the same reason).",
+        since="PR 19 (0.18.0)",
+    ),
+    EnvFlag(
         "RIPTIDE_INTEGRITY_PROBE_EVERY", "int", 0,
         "Shadow-probe cadence of `RIPTIDE_INTEGRITY=probe`: dispatch "
         "every Nth chunk twice through the already-compiled "

@@ -1,0 +1,225 @@
+"""
+PR 19 acceptance: the on-device post-search tail and multi-core wire
+prep.
+
+* ``RIPTIDE_DEVICE_CLUSTER`` byte-parity — peaks.csv and candidates.csv
+  are byte-identical with the flag on and off, across the quantised
+  wire transports and through a DM-batched kill-and-resume survey (the
+  flag changes WHERE the clustering tail runs, never what comes out).
+* dispatch regression — flag off queues ZERO extra device programs;
+  flag on rides the cluster sections inside the existing fused peak
+  program (exactly one ``dispatch_cluster`` count per chunk, every
+  other dispatch kind unchanged).
+* ``RIPTIDE_PREP_THREADS`` determinism — the native wire prep produces
+  byte-identical wire digests (and identical results) at any thread
+  count, verified under ``RIPTIDE_INTEGRITY=digest``.
+"""
+import numpy as np
+import pytest
+
+from riptide_tpu.pipeline import Pipeline
+from riptide_tpu.pipeline.batcher import BatchSearcher
+from riptide_tpu.survey.journal import SurveyJournal
+from riptide_tpu.survey.metrics import get_metrics
+from riptide_tpu.survey.scheduler import SurveyScheduler
+from riptide_tpu.survey.faults import FaultAbort
+
+from synth import generate_data_presto
+
+TOBS = 16.0
+TSAMP = 1e-3
+PERIOD = 0.5
+AMPLITUDES = {0.0: 15.0, 10.0: 40.0, 20.0: 15.0}
+
+SEARCH_CONF = [{
+    "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                   "bins_min": 64, "bins_max": 71},
+    "find_peaks": {"smin": 6.0},
+}]
+
+# Every dispatch-counter kind the engine maintains, plus PR 19's.
+DISPATCH_KINDS = ("fused", "pack", "kernel", "unpack", "gather",
+                  "slice", "cluster")
+
+
+def _survey_config(processes=1):
+    return {
+        "processes": processes,
+        "data": {"format": "presto", "fmin": None, "fmax": None,
+                 "nchans": None},
+        "dmselect": {"min": 0.0, "max": 30.0, "dmsinb_max": None},
+        "dereddening": {"rmed_width": 4.0, "rmed_minpts": 101},
+        "ranges": [{
+            "name": "test",
+            "ffa_search": {"period_min": 0.3, "period_max": 1.2,
+                           "bins_min": 64, "bins_max": 71,
+                           "fpmin": 8, "wtsp": 1.5, "ducy_max": 0.30},
+            "find_peaks": {"smin": 6.0},
+            "candidates": {"bins": 64, "subints": 8},
+        }],
+        "clustering": {"radius": 0.2},
+        "harmonic_flagging": {"denom_max": 100, "phase_distance_max": 1.0,
+                              "dm_distance_max": 3.0,
+                              "snr_distance_max": 3.0},
+        "candidate_filters": {"dm_min": None, "snr_min": 7.0,
+                              "remove_harmonics": True, "max_number": None},
+        "plot_candidates": False,
+    }
+
+
+def _make_survey(outdir, dms=(0.0, 10.0, 20.0)):
+    return [
+        generate_data_presto(
+            str(outdir), f"fake_DM{dm:.2f}", tobs=TOBS, tsamp=TSAMP,
+            period=PERIOD, dm=dm, amplitude=AMPLITUDES[dm], ducy=0.02,
+        )
+        for dm in dms
+    ]
+
+
+def _run_pipeline(files, outdir, processes=1, **kwargs):
+    outdir.mkdir(exist_ok=True)
+    get_metrics().reset()
+    Pipeline(_survey_config(processes), **kwargs).process(
+        [str(f) for f in files], str(outdir))
+
+
+def _products(outdir):
+    return {p: (outdir / p).read_bytes()
+            for p in ("peaks.csv", "candidates.csv")}
+
+
+def _searcher():
+    return BatchSearcher({"rmed_width": 4.0, "rmed_minpts": 101},
+                         SEARCH_CONF, fmt="presto", io_threads=1)
+
+
+def _two_trials(tmp_path):
+    f1 = generate_data_presto(str(tmp_path), "a_DM0.00", tobs=TOBS,
+                              tsamp=TSAMP, period=PERIOD, dm=0.0,
+                              amplitude=25.0)
+    f2 = generate_data_presto(str(tmp_path), "b_DM5.00", tobs=TOBS,
+                              tsamp=TSAMP, period=PERIOD, dm=5.0,
+                              amplitude=25.0)
+    return f1, f2
+
+
+def _dispatch_counts():
+    m = get_metrics()
+    return {k: int(m.counter(f"dispatch_{k}")) for k in DISPATCH_KINDS}
+
+
+# -------------------------------------------------- flag byte-parity
+
+@pytest.mark.parametrize("wire", ["uint6", "uint8", "uint12"])
+def test_csv_byte_parity_flag_on_off(tmp_path, monkeypatch, wire):
+    """peaks.csv and candidates.csv byte-identical with on-device
+    clustering on and off, over each quantised wire transport."""
+    indir = tmp_path / "data"
+    indir.mkdir()
+    files = _make_survey(indir, dms=(0.0, 10.0))
+    monkeypatch.setenv("RIPTIDE_WIRE_DTYPE", wire)
+
+    monkeypatch.setenv("RIPTIDE_DEVICE_CLUSTER", "1")
+    _run_pipeline(files, tmp_path / "on")
+    on = _products(tmp_path / "on")
+    assert get_metrics().counter("dispatch_cluster") == len(files)
+
+    monkeypatch.setenv("RIPTIDE_DEVICE_CLUSTER", "0")
+    _run_pipeline(files, tmp_path / "off")
+    off = _products(tmp_path / "off")
+    assert get_metrics().counter("dispatch_cluster") == 0
+
+    for product in on:
+        assert on[product] == off[product], (
+            f"{product} differs between device and host clustering "
+            f"({wire} wire)")
+
+
+def test_csv_byte_parity_dm_batched_resume(tmp_path, monkeypatch):
+    """A DM-batched (2 trials per chunk) survey killed after its first
+    chunk and resumed with the flag ON produces byte-identical CSVs to
+    an uninterrupted flag-OFF run: flag parity and replay parity in one
+    pass."""
+    indir = tmp_path / "data"
+    indir.mkdir()
+    files = _make_survey(indir)
+
+    monkeypatch.setenv("RIPTIDE_DEVICE_CLUSTER", "0")
+    _run_pipeline(files, tmp_path / "off", processes=2)
+
+    monkeypatch.setenv("RIPTIDE_DEVICE_CLUSTER", "1")
+    jdir = str(tmp_path / "journal")
+    with pytest.raises(FaultAbort):
+        _run_pipeline(files, tmp_path / "on", processes=2, journal=jdir,
+                      fault_spec="abort:1")
+    assert sorted(SurveyJournal(jdir).completed_chunks()) == [0]
+    _run_pipeline(files, tmp_path / "on", processes=2, journal=jdir,
+                  resume=True, fault_spec="")
+    assert get_metrics().counter("chunks_skipped") == 1
+
+    on, off = _products(tmp_path / "on"), _products(tmp_path / "off")
+    for product in on:
+        assert on[product] == off[product], (
+            f"{product} differs between resumed flag-on and "
+            "uninterrupted flag-off runs")
+
+
+# ---------------------------------------------- dispatch regression
+
+def test_device_cluster_dispatch_regression(tmp_path, monkeypatch):
+    """Flag off: zero cluster dispatches and the flag adds no program
+    of any other kind. Flag on: exactly one cluster program per chunk,
+    fused into the peak program (every other dispatch count
+    unchanged), and the peak lists bit-identical."""
+    f1, f2 = _two_trials(tmp_path)
+
+    monkeypatch.setenv("RIPTIDE_DEVICE_CLUSTER", "0")
+    get_metrics().reset()
+    peaks_off = SurveyScheduler(_searcher(), [[f1], [f2]]).run()
+    off = _dispatch_counts()
+    assert off.pop("cluster") == 0
+
+    monkeypatch.setenv("RIPTIDE_DEVICE_CLUSTER", "1")
+    get_metrics().reset()
+    peaks_on = SurveyScheduler(_searcher(), [[f1], [f2]]).run()
+    on = _dispatch_counts()
+    assert on.pop("cluster") == 2  # exactly one per chunk
+
+    assert on == off, "flag state changed non-cluster dispatch counts"
+    assert peaks_on == peaks_off
+
+
+# ------------------------------------------ prep-thread determinism
+
+def _digest_run(files, jdir):
+    get_metrics().reset()
+    peaks = SurveyScheduler(
+        _searcher(), [[f] for f in files],
+        journal=SurveyJournal(str(jdir)),
+    ).run()
+    from riptide_tpu.obs.report import read_journal
+
+    chunks = read_journal(str(jdir))["chunks"]
+    digests = {cid: (rec.get("wire_digest"),
+                     (rec.get("integrity") or {}).get("result"))
+               for cid, rec in chunks.items()}
+    return peaks, digests
+
+
+def test_prep_threads_byte_identical(tmp_path, monkeypatch):
+    """N=1 vs N=4 prep threads: identical per-chunk wire digests,
+    identical Ring-1 result digests (RIPTIDE_INTEGRITY=digest) and
+    identical peaks — the thread count is a pure throughput knob."""
+    files = _two_trials(tmp_path)
+    monkeypatch.setenv("RIPTIDE_INTEGRITY", "digest")
+
+    monkeypatch.setenv("RIPTIDE_PREP_THREADS", "1")
+    peaks1, dig1 = _digest_run(files, tmp_path / "j1")
+    monkeypatch.setenv("RIPTIDE_PREP_THREADS", "4")
+    peaks4, dig4 = _digest_run(files, tmp_path / "j4")
+
+    assert dig1 == dig4
+    assert all(w is not None and r is not None
+               for w, r in dig1.values())
+    assert peaks1 == peaks4

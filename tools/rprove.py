@@ -47,7 +47,7 @@ CONTRACT_REL = "tools/plan_contracts.json"
 # the package configures itself.
 _CONTRACT_ENV = ("RIPTIDE_FFA_PATH", "RIPTIDE_WIRE_DTYPE",
                  "RIPTIDE_KERNEL_LANE_SPLIT", "RIPTIDE_KERNEL_BASE3",
-                 "RIPTIDE_KERNEL_RESIDENT")
+                 "RIPTIDE_KERNEL_RESIDENT", "RIPTIDE_DEVICE_CLUSTER")
 
 
 def _force_cpu():

@@ -73,8 +73,25 @@ def main(outdir="/tmp/riptide_trace_demo"):
     spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
     names = {e["name"] for e in spans}
     missing = {"stage", "ship", "queue", "collect", "journal",
-               "prep", "wire", "dispatch", "device"} - names
+               "prep", "wire", "dispatch", "device", "cluster"} - names
     assert not missing, f"trace is missing spans: {missing}"
+    # The cluster span moved off the serial host path (PR 19): with the
+    # default RIPTIDE_DEVICE_CLUSTER it lives INSIDE a collect span's
+    # time range (the post-pull tail), and the dispatch counter proves
+    # the fused peak program carried the cluster sections — exactly one
+    # cluster dispatch per chunk, no separate host-path program.
+    m = get_metrics()
+    assert m.counter("dispatch_cluster") == len(files), (
+        "expected one on-device cluster dispatch per chunk, got "
+        f"{m.counter('dispatch_cluster')} for {len(files)} chunk(s)")
+    collects = [(e["ts"], e["ts"] + e["dur"]) for e in spans
+                if e["name"] == "collect"]
+    for e in spans:
+        if e["name"] != "cluster":
+            continue
+        inside = any(t0 - 1 <= e["ts"] and e["ts"] + e["dur"] <= t1 + 1
+                     for t0, t1 in collects)
+        assert inside, "cluster span escaped the collect phase"
 
     # Journal lines carry a per-record CRC32 suffix (PR 11); the report
     # module's lenient parser strips AND verifies it.
@@ -88,6 +105,9 @@ def main(outdir="/tmp/riptide_trace_demo"):
         t = rec["timings"]
         serial = t["wire_s"] + t["queue_s"] + t["collect_s"] + t["host_s"]
         assert abs(serial - t["chunk_s"]) <= 0.05 * max(t["chunk_s"], 1e-9)
+        # PR 19 sub-phases: reported, inside collect_s, never summed.
+        assert 0.0 <= t["cluster_s"] <= t["postsearch_s"] + 1e-9
+        assert t["postsearch_s"] <= t["collect_s"] + 1e-9
 
     with open(promfile) as fobj:
         page = fobj.read()
