@@ -4,13 +4,14 @@ PYTHON ?= python
 
 .PHONY: install check check-full prove repin lint native-asan sanitize \
 	tests tests-cov native bench trace-demo report-demo watch-demo \
-	serve-demo chaos clean
+	serve-demo ripsched ripsched-demo analyze chaos clean
 
 install:
 	$(PYTHON) -m pip install -e .
 
-# Static analysis: the riplint framework (tools/riplint.py — 11
-# analyzers including the whole-program call-graph rules RIP009-011)
+# Static analysis: the riplint framework (tools/riplint.py — 14
+# analyzers including the whole-program call-graph rules RIP009-011
+# and the thread-discipline rules RIP012-014)
 # against the checked-in baseline, using the mtime+size result cache
 # (.riplint_cache.json): an unchanged tree replays in well under a
 # second. Also enforced in tier-1 via tests/test_riplint.py; the old
@@ -41,12 +42,38 @@ repin:
 	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py --update --all
 	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py --all
 
-# The CI form: AST analyzers uncached + the semantic pass + the fleet/
-# alert e2e acceptance (watch-demo) + the survey-service e2e
-# acceptance (serve-demo).
-check-full: watch-demo serve-demo
+# Concurrency verification: the schedule-exploration model checker
+# (tools/ripsched.py) runs the serve plane's REAL protocol code —
+# FairShareQueue pick/drain, the staging pool, runctx incident
+# routing, the integrity quarantine latch — under a controlled
+# scheduler, exploring every interleaving to the preemption bound
+# (RIPTIDE_SCHED_BOUND, default 2) and checking the 18 pinned
+# invariants in tools/ripsched_invariants.json. A violation prints a
+# minimal failing schedule replayable with --replay <id>.
+ripsched:
+	$(PYTHON) tools/ripsched.py
+
+# ripsched acceptance: clean models explore clean, a re-armed
+# known-bad mutation (a dropped notify in the drain path) is FOUND
+# with a minimal replayable schedule, and the replay is
+# byte-deterministic. Wired into check-full.
+ripsched-demo:
+	PYTHONPATH= JAX_PLATFORMS=cpu $(PYTHON) tools/ripsched_demo.py
+
+# The whole static surface as ONE SARIF document (riptide.sarif):
+# riplint + rprove + ripsched merged one run per tool — the shape
+# code-scanning uploaders ingest. Exit = max of the tools' exits.
+analyze:
+	$(PYTHON) tools/analyze.py
+
+# The CI form: AST analyzers uncached + the semantic pass + the
+# schedule-exploration pass + its acceptance demo + the fleet/alert
+# e2e acceptance (watch-demo) + the survey-service e2e acceptance
+# (serve-demo).
+check-full: watch-demo serve-demo ripsched-demo
 	$(PYTHON) tools/riplint.py --no-cache
 	JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/rprove.py
+	$(PYTHON) tools/ripsched.py
 
 # Everything static (uncached, AST + semantic) + the sanitizer-built
 # native tests: the full pre-merge hygiene gate.

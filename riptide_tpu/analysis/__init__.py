@@ -49,6 +49,21 @@ RIP011    interp-host-sync            RIP001 lifted to call-graph
                                       reachability: sync pulls hidden in
                                       helpers called from jit bodies or
                                       Pallas kernel closures
+RIP012    runctx-discipline           threads spawned from the serve/
+                                      survey planes carry a run context
+                                      (runctx.wrap-ed target or one that
+                                      installs its own), and no
+                                      context-free thread reaches
+                                      incidents.emit
+RIP013    fsio-discipline             survey/obs/serve write durable
+                                      bytes only through utils/fsio.py
+                                      (no raw write-mode open(),
+                                      os.replace, os.write)
+RIP014    gate-pairing                chunk_gate begin/end, StagingPool
+                                      acquire/release and integrity
+                                      begin_fold/finish_fold pair on
+                                      every path (try/finally, with, or
+                                      ownership escape)
 ========  ==========================  =====================================
 
 Run via ``tools/riplint.py`` (GitHub-annotation output, checked-in
@@ -72,6 +87,9 @@ from .obs_discipline import ObsDisciplineAnalyzer
 from .lock_order import LockOrderAnalyzer
 from .record_schema import RecordSchemaAnalyzer
 from .interp_host_sync import InterpHostSyncAnalyzer
+from .runctx_discipline import RunctxDisciplineAnalyzer
+from .fsio_discipline import FsioDisciplineAnalyzer
+from .gate_pairing import GatePairingAnalyzer
 
 ALL_ANALYZERS = (
     HostSyncAnalyzer,
@@ -85,6 +103,9 @@ ALL_ANALYZERS = (
     LockOrderAnalyzer,
     RecordSchemaAnalyzer,
     InterpHostSyncAnalyzer,
+    RunctxDisciplineAnalyzer,
+    FsioDisciplineAnalyzer,
+    GatePairingAnalyzer,
 )
 
 __all__ = [

@@ -67,6 +67,10 @@ FINGERPRINT_EXCLUDE = frozenset({
     # differing only in core count must fingerprint as the same flag
     # regime or every thread-count experiment would break --compare.
     "RIPTIDE_PREP_THREADS",
+    # ripsched model-checker knobs (PR 20): consumed only by
+    # tools/ripsched.py exploring standalone-loaded protocol models —
+    # no survey run reads them, so they cannot affect a measured row.
+    "RIPTIDE_SCHED_BOUND", "RIPTIDE_SCHED_SEED", "RIPTIDE_SCHED_REPLAY",
 })
 
 

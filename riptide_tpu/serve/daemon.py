@@ -185,8 +185,7 @@ def write_peaks_csv(peaks, path):
     import pandas
 
     if not peaks:
-        with open(path, "w") as fobj:
-            fobj.write("")
+        fsio.atomic_write_text(path, "")
         return
     pandas.DataFrame.from_dict(
         [p.summary_dict() for p in peaks]
@@ -710,7 +709,12 @@ class ServeDaemon:
             # re-queues it (`resumed`) and its journal picks up at the
             # chunk after the one that finished. In-memory status is
             # left running too: /status and /jobs keep telling the
-            # truth while the daemon finishes draining.
+            # truth while the daemon finishes draining. The park IS
+            # journaled: this worker runs under its job's RunContext,
+            # so the record lands in the job's own incident journal —
+            # the context routing RIP012 and ripsched's runctx model
+            # both guard.
+            incidents.emit("job_drained", job_id=jid, tenant=tenant)
             log.info("serve: %s parked at chunk boundary for drain "
                      "(resumable on restart)", jid)
         except JobCancelled:

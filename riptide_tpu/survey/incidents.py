@@ -71,6 +71,7 @@ INCIDENT_KINDS = (
     "result_mismatch",    # integrity: result digests diverged (shadow/replay)
     "integrity_quarantine",  # integrity: device marked suspect, chunks parked
     "canary_failed",      # integrity: golden canary missed its pinned digest
+    "job_drained",        # serve: job parked resumable at a drain boundary
 )
 
 _lock = threading.Lock()

@@ -419,6 +419,36 @@ _ALL = [
         "regardless.",
         since="PR 18 (0.17.0)",
     ),
+    EnvFlag(
+        "RIPTIDE_SCHED_BOUND", "int", 2,
+        "Preemption bound of the `ripsched` schedule-exploration model "
+        "checker (`make ripsched`): schedules with at most this many "
+        "preemptive context switches are explored exhaustively, "
+        "shallowest first, so any violation found is minimal in "
+        "preemptions. Raising it widens coverage at exponential cost. "
+        "Checker-only knob — never read by a survey run, and excluded "
+        "from the ledger envflag fingerprint.",
+        since="PR 20 (0.19.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_SCHED_SEED", "int", 0,
+        "Seed ordering the alternatives `ripsched` expands first "
+        "within each preemption bound. Changes which violation (if "
+        "several exist) is reported first, never whether one is found "
+        "at the bound; replay IDs embed the decision digits and do "
+        "not depend on it. Checker-only knob, excluded from the "
+        "ledger envflag fingerprint.",
+        since="PR 20 (0.19.0)",
+    ),
+    EnvFlag(
+        "RIPTIDE_SCHED_REPLAY", "str", "",
+        "When non-empty, `tools/ripsched.py` replays this recorded "
+        "schedule ID (`model[+mutation]:digits`) deterministically "
+        "instead of exploring — the repro workflow printed with every "
+        "violation. Checker-only knob, excluded from the ledger "
+        "envflag fingerprint.",
+        since="PR 20 (0.19.0)",
+    ),
 ]
 
 FLAGS = {f.name: f for f in _ALL}

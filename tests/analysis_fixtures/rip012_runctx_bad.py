@@ -1,0 +1,28 @@
+"""RIP012 bad fixture: serve-plane thread spawns without a context
+route (destination: riptide_tpu/serve/spawnmod.py; the real runctx.py
+and incidents.py ride along in the mini repo so the wrap/establish/
+emit fqns resolve)."""
+import threading
+
+from ..survey import incidents
+from ..utils import runctx  # noqa: F401  (imported but never used: the bug)
+
+
+class Daemon:
+    def _worker(self):
+        # Reaches incidents.emit -> prong 2 when spawned unwrapped.
+        incidents.emit("chunk_parked", reason="drill")
+
+    def _plain(self):
+        return 1
+
+    def start(self):
+        # Unwrapped target that emits: finding (prong 2).
+        threading.Thread(target=self._worker, daemon=True).start()
+        # Unwrapped target in the serve plane: finding (prong 1).
+        threading.Thread(target=self._plain, daemon=True).start()
+
+    def enqueue(self, pool):
+        # Plain alias does not launder the handoff: finding.
+        handle = self._worker
+        pool.submit(handle)

@@ -1,0 +1,33 @@
+"""RIP012 good fixture: every serve-plane thread either goes through
+runctx.wrap or establishes its own context (destination:
+riptide_tpu/serve/spawnmod.py)."""
+import threading
+
+from ..survey import incidents
+from ..utils import runctx
+
+
+class Daemon:
+    def _worker(self):
+        incidents.emit("chunk_parked", reason="drill")
+
+    def _job_loop(self):
+        # Establishes its own context: compliant without wrap().
+        ctx = runctx.RunContext(label="job")
+        prev = runctx.install(ctx)
+        try:
+            incidents.emit("chunk_parked", reason="drill")
+        finally:
+            runctx.install(prev)
+
+    def start(self):
+        # Wrapped inline.
+        threading.Thread(target=runctx.wrap(self._worker),
+                         daemon=True).start()
+        # Context-establishing target.
+        threading.Thread(target=self._job_loop, daemon=True).start()
+
+    def enqueue(self, pool):
+        # Wrap-alias form.
+        handle = runctx.wrap(self._worker)
+        pool.submit(handle)

@@ -1,0 +1,22 @@
+"""RIP013 bad fixture: raw durable writes in a persistence-plane
+module (destination: riptide_tpu/obs/writer.py)."""
+import os
+
+
+def rotate(path):
+    os.replace(path, path + ".1")
+
+
+def dump(path, text):
+    with open(path, "w") as fobj:
+        fobj.write(text)
+
+
+def dump_fd(fd, data):
+    os.write(fd, data)
+
+
+def append_line(path, line):
+    fobj = open(path, mode="ab")
+    fobj.write(line)
+    fobj.close()

@@ -1,0 +1,27 @@
+"""RIP013 good fixture: reads are free, writes route through fsio,
+and a non-literal mode is (conservatively) not flagged (destination:
+riptide_tpu/obs/writer.py)."""
+from ..utils import fsio
+
+
+def publish(path, data):
+    fsio.atomic_write_bytes(path, data)
+
+
+def publish_text(path, text):
+    fsio.atomic_write_text(path, text)
+
+
+def read(path):
+    with open(path) as fobj:
+        return fobj.read()
+
+
+def read_bytes(path):
+    with open(path, "rb") as fobj:
+        return fobj.read()
+
+
+def reopen(path, mode):
+    # Dynamic mode: the zero-alias contract says no finding.
+    return open(path, mode)

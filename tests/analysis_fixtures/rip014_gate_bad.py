@@ -1,0 +1,21 @@
+"""RIP014 bad fixture: begin/acquire whose close is not on every path
+(destination: riptide_tpu/survey/gatemod.py)."""
+
+
+def run_chunk(chunk_gate, cid, work):
+    chunk_gate.begin(cid)
+    work(cid)          # raises -> the device turn is held forever
+    chunk_gate.end(cid)
+
+
+def prep(pool, fill):
+    buf = pool.acquire((4, 4), "float32")
+    fill(buf)          # raises -> the staging buffer leaks
+    pool.release(buf)
+
+
+class Folder:
+    def fold(self, compute):
+        acc = self.integrity.begin_fold("c0")
+        compute(acc)   # raises -> the fold accumulator never closes
+        return self.integrity.finish_fold(acc)

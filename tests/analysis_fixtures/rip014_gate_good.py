@@ -1,0 +1,46 @@
+"""RIP014 good fixture: try/finally pairing, ownership escape, and an
+out-of-protocol receiver name (destination:
+riptide_tpu/survey/gatemod.py)."""
+
+
+def run_chunk(chunk_gate, cid, work):
+    chunk_gate.begin(cid)
+    try:
+        work(cid)
+    finally:
+        chunk_gate.end(cid)
+
+
+def prep(pool, fill):
+    buf = pool.acquire((4, 4), "float32")
+    try:
+        fill(buf)
+    finally:
+        pool.release(buf)
+
+
+def prep_handoff(pool):
+    # Ownership escapes to the caller: release is its job.
+    buf = pool.acquire((4, 4), "float32")
+    return buf
+
+
+def prep_stash(pool, meta):
+    out = pool.acquire((4, 4), "float32")
+    meta["staging"] = out
+    return meta
+
+
+class Folder:
+    def fold(self, compute):
+        acc = self.integrity.begin_fold("c0")
+        try:
+            compute(acc)
+        finally:
+            return self.integrity.finish_fold(acc)
+
+
+def other_protocol(session, cid):
+    # Receiver outside the protocol name sets: not this rule's business.
+    session.begin(cid)
+    session.end(cid)

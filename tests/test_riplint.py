@@ -5,7 +5,7 @@ Tier-1 enforcement of the riplint static-analysis framework
 * the repo itself is clean against the checked-in baseline (this is
   the tier-1 wiring of every analyzer, including the whole-program
   RIP009/RIP010/RIP011 rules — each also wired individually below);
-* each of the 11 analyzers fails on its bad fixture and passes on its
+* each of the 14 analyzers fails on its bad fixture and passes on its
   good fixture (tests/analysis_fixtures/ — guard against vacuous
   lints);
 * the runner's exit codes, baseline absorption, stale-entry detection
@@ -97,6 +97,10 @@ CASES = [
      "rip007_liveness_bad.py", "rip007_liveness_good.py", 2),
     (analysis.ObsDisciplineAnalyzer, "riptide_tpu/obs/fixture.py",
      "rip008_obs_bad.py", "rip008_obs_good.py", 4),
+    (analysis.FsioDisciplineAnalyzer, "riptide_tpu/obs/writer.py",
+     "rip013_fsio_bad.py", "rip013_fsio_good.py", 4),
+    (analysis.GatePairingAnalyzer, "riptide_tpu/survey/gatemod.py",
+     "rip014_gate_bad.py", "rip014_gate_good.py", 3),
 ]
 
 
@@ -136,17 +140,21 @@ PROJECT_CASES = [
      RECMOD, "rip010_schema_bad.py", "rip010_schema_good.py", 3),
     (analysis.InterpHostSyncAnalyzer, "riptide_tpu/ops/helpers.py",
      "rip011_interp_bad.py", "rip011_interp_good.py", 2),
+    (analysis.RunctxDisciplineAnalyzer, "riptide_tpu/serve/spawnmod.py",
+     "rip012_runctx_bad.py", "rip012_runctx_good.py", 3),
 ]
 
 
 def _project_mini_repo(tmp_path, mapping):
     """A _mini_repo that also carries the real obs/schema.py (the
-    RIP010 DECOMPOSITION_KEYS source)."""
+    RIP010 DECOMPOSITION_KEYS source) plus utils/runctx.py and
+    survey/incidents.py (the RIP012 establish/emit anchors)."""
     repo = _mini_repo(tmp_path, mapping)
-    dest = tmp_path / "riptide_tpu" / "obs" / "schema.py"
-    dest.parent.mkdir(parents=True, exist_ok=True)
-    shutil.copy(os.path.join(REPO, "riptide_tpu", "obs", "schema.py"),
-                dest)
+    for rel in (("obs", "schema.py"), ("utils", "runctx.py"),
+                ("survey", "incidents.py")):
+        dest = tmp_path / "riptide_tpu" / rel[0] / rel[1]
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, "riptide_tpu", *rel), dest)
     return repo
 
 
@@ -176,7 +184,10 @@ def test_project_analyzer_fails_bad_and_passes_good(tmp_path, factory,
 
 @pytest.mark.parametrize("cls", ["LockOrderAnalyzer",
                                  "RecordSchemaAnalyzer",
-                                 "InterpHostSyncAnalyzer"])
+                                 "InterpHostSyncAnalyzer",
+                                 "RunctxDisciplineAnalyzer",
+                                 "FsioDisciplineAnalyzer",
+                                 "GatePairingAnalyzer"])
 def test_new_rule_clean_on_repo_against_baseline(cls):
     """Tier-1 wiring of each whole-program rule individually: the real
     repo is clean (any sanctioned site is a justified baseline entry,
@@ -481,6 +492,66 @@ def test_renamed_journal_key_is_caught(tmp_path):
                for f in new), [f.gh() for f in new]
 
 
+def test_unwrapped_stage_submit_is_caught(tmp_path):
+    """RIP012 non-vacuity on the REAL scheduler: drop the runctx.wrap
+    around the staging-thread target and the rule must flag the raw
+    submit (its incident/journal writes would land in the pool
+    worker's empty context)."""
+    rels = ["riptide_tpu/survey/scheduler.py",
+            "riptide_tpu/utils/runctx.py",
+            "riptide_tpu/survey/incidents.py"]
+    repo = _copy_real(tmp_path, rels)
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.RunctxDisciplineAnalyzer],
+        baseline=analysis.Baseline())
+    assert new == [], "\n".join(f.gh() for f in new)
+
+    _patched(tmp_path / "riptide_tpu" / "survey" / "scheduler.py",
+             "stage = runctx.wrap(self._stage)",
+             "stage = self._stage")
+    new, _, _ = analysis.run_analyzers(
+        repo, [analysis.RunctxDisciplineAnalyzer],
+        baseline=analysis.Baseline())
+    assert any(f.rule == "RIP012" and "_stage" in f.message
+               for f in new), [f.gh() for f in new]
+
+
+def test_raw_peaks_csv_write_is_caught(tmp_path):
+    """RIP013 non-vacuity on the REAL daemon: reintroduce the raw
+    empty-peaks open() that fsio.atomic_write_text replaced and the
+    rule must flag it."""
+    dest = "riptide_tpu/serve/daemon.py"
+    repo = _copy_real(tmp_path, [dest])
+    inst = analysis.FsioDisciplineAnalyzer()
+    assert _run_one(repo, inst, dest) == []
+
+    _patched(tmp_path / "riptide_tpu" / "serve" / "daemon.py",
+             'fsio.atomic_write_text(path, "")',
+             'open(path, "w").close()')
+    new = _run_one(repo, analysis.FsioDisciplineAnalyzer(), dest)
+    assert len(new) == 1 and new[0].rule == "RIP013", \
+        [f.gh() for f in new]
+    assert "open" in new[0].message
+
+
+def test_dropped_chunk_gate_end_is_caught(tmp_path):
+    """RIP014 non-vacuity on the REAL scheduler: drop the end() from
+    the turn-accounting finally and the rule must flag the begin()
+    (a parked/failed chunk would hold the device turn forever)."""
+    dest = "riptide_tpu/survey/scheduler.py"
+    repo = _copy_real(tmp_path, [dest])
+    inst = analysis.GatePairingAnalyzer()
+    assert _run_one(repo, inst, dest) == []
+
+    _patched(tmp_path / "riptide_tpu" / "survey" / "scheduler.py",
+             "self.chunk_gate.end(cid)",
+             "pass")
+    new = _run_one(repo, analysis.GatePairingAnalyzer(), dest)
+    assert len(new) == 1 and new[0].rule == "RIP014", \
+        [f.gh() for f in new]
+    assert "begin" in new[0].message
+
+
 def test_kernel_root_leaf_name_does_not_capture_methods(tmp_path):
     """A class method sharing a Pallas kernel root's leaf name is host
     code: it must be neither treated as a traced root (false RIP011
@@ -777,9 +848,12 @@ def test_analyzer_set_and_rule_ids_are_stable():
         ("RIP009", "lock-order"),
         ("RIP010", "record-schema"),
         ("RIP011", "interp-host-sync"),
+        ("RIP012", "runctx-discipline"),
+        ("RIP013", "fsio-discipline"),
+        ("RIP014", "gate-pairing"),
     }
     rules = [a.rule for a in analysis.ALL_ANALYZERS]
-    assert len(rules) == len(set(rules)) == 11
+    assert len(rules) == len(set(rules)) == 14
 
 
 def test_list_rules_enumerates_the_set():
@@ -787,12 +861,14 @@ def test_list_rules_enumerates_the_set():
                           capture_output=True, text=True, cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     lines = [l for l in proc.stdout.splitlines() if l.strip()]
-    assert len(lines) == 11
+    assert len(lines) == 14
     ids = [l.split()[0] for l in lines]
-    assert ids == [f"RIP{n:03d}" for n in range(1, 12)]
+    assert ids == [f"RIP{n:03d}" for n in range(1, 15)]
     assert any("lock-order" in l for l in lines)
     assert any("record-schema" in l for l in lines)
     assert any("interp-host-sync" in l for l in lines)
+    assert any("runctx-discipline" in l for l in lines)
+    assert any("gate-pairing" in l for l in lines)
 
 
 def test_env_docs_in_sync_with_registry():
@@ -964,6 +1040,86 @@ def test_cache_not_used_for_custom_analyzer_sets():
     assert "1 analyzers" in err.getvalue()
 
 
+def test_prune_baseline_drops_unmatched_entries(tmp_path):
+    """--prune-baseline lifecycle: absorb real findings into a
+    baseline, inject an entry matching nothing, prune (drops ONLY the
+    unmatched entry), and a plain rerun against the pruned file is
+    clean."""
+    dest = "riptide_tpu/obs/writer.py"
+    repo = _mini_repo(tmp_path, {dest: "rip013_fsio_bad.py"})
+    bl = tmp_path / "baseline.json"
+    analyzers = [analysis.FsioDisciplineAnalyzer]
+
+    code = riplint.run(repo=repo, baseline_path=str(bl),
+                       analyzers=analyzers, update_baseline=True,
+                       out=io.StringIO(), err=io.StringIO())
+    assert code == 0
+    entries = json.loads(bl.read_text())["entries"]
+    n_real = len(entries)
+    assert n_real >= 4
+
+    bogus = {"rule": "RIP013", "path": dest,
+             "line_text": "this_line_does_not_exist()", "why": "gone"}
+    bl.write_text(json.dumps({"entries": entries + [bogus]}))
+    # A plain run reports (and fails on) the stale entry...
+    out1, err1 = io.StringIO(), io.StringIO()
+    code1 = riplint.run(repo=repo, baseline_path=str(bl),
+                        analyzers=analyzers, out=out1, err=err1)
+    assert code1 == 1 and "STALE" in out1.getvalue()
+    # ... prune drops it (and only it) ...
+    out2, err2 = io.StringIO(), io.StringIO()
+    code2 = riplint.run(repo=repo, baseline_path=str(bl),
+                        analyzers=analyzers, prune_baseline=True,
+                        out=out2, err=err2)
+    assert code2 == 0, out2.getvalue() + err2.getvalue()
+    assert "baseline pruned" in err2.getvalue()
+    pruned = json.loads(bl.read_text())["entries"]
+    assert len(pruned) == n_real and bogus not in pruned
+    # ... and the plain rerun against the pruned file is clean.
+    out3, err3 = io.StringIO(), io.StringIO()
+    code3 = riplint.run(repo=repo, baseline_path=str(bl),
+                        analyzers=analyzers, out=out3, err=err3)
+    assert code3 == 0, out3.getvalue() + err3.getvalue()
+
+
+def test_prune_baseline_still_fails_on_new_findings(tmp_path):
+    """Pruning must not launder NEW findings: a prune run over a tree
+    with unbaselined findings still exits 1 (it only rewrites the
+    entry list, it does not absorb)."""
+    dest = "riptide_tpu/obs/writer.py"
+    repo = _mini_repo(tmp_path, {dest: "rip013_fsio_bad.py"})
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"entries": [
+        {"rule": "RIP013", "path": dest,
+         "line_text": "this_line_does_not_exist()", "why": "gone"}]}))
+    out, err = io.StringIO(), io.StringIO()
+    code = riplint.run(repo=repo, baseline_path=str(bl),
+                       analyzers=[analysis.FsioDisciplineAnalyzer],
+                       prune_baseline=True, out=out, err=err)
+    assert code == 1, out.getvalue() + err.getvalue()
+    assert json.loads(bl.read_text())["entries"] == []
+
+
+def test_cache_tracks_ripsched_surface():
+    """The ripsched analyzer source and its pinned invariant specs are
+    inside the cache's tracked file set: touching either must
+    invalidate a cached replay."""
+    riplint.run(out=io.StringIO(), err=io.StringIO())  # prime
+    err0 = io.StringIO()
+    riplint.run(out=io.StringIO(), err=err0)
+    assert "[cached]" in err0.getvalue()
+
+    os.utime(os.path.join(REPO, "tools", "ripsched_invariants.json"))
+    err1 = io.StringIO()
+    riplint.run(out=io.StringIO(), err=err1)
+    assert "[cached]" not in err1.getvalue()
+
+    os.utime(os.path.join(REPO, "riptide_tpu", "analysis", "sched.py"))
+    err2 = io.StringIO()
+    riplint.run(out=io.StringIO(), err=err2)
+    assert "[cached]" not in err2.getvalue()
+
+
 def test_sarif_output_schema():
     out, err = io.StringIO(), io.StringIO()
     code = riplint.run(out=out, err=err, fmt="sarif", use_cache=False)
@@ -974,7 +1130,7 @@ def test_sarif_output_schema():
     assert run["tool"]["driver"]["name"] == "riplint"
     rules = run["tool"]["driver"]["rules"]
     assert [r["id"] for r in rules] == \
-        [f"RIP{n:03d}" for n in range(1, 12)]
+        [f"RIP{n:03d}" for n in range(1, 15)]
     assert all(r["shortDescription"]["text"] for r in rules)
     assert run["results"] == []  # clean repo
 
@@ -986,7 +1142,7 @@ def test_sarif_findings_and_stale_entries_become_results():
                  "rule": "RIP009", "message": "lock-order inversion"}],
         "stale": [{"rule": "RIP004", "path": "riptide_tpu/y.py",
                    "line_text": "gone()", "why": "old"}],
-        "baselined": 0, "n_rules": 11, "n_modules": 1,
+        "baselined": 0, "n_rules": 14, "n_modules": 1,
     }
     doc = riplint._sarif_doc(result, instances)
     results = doc["runs"][0]["results"]

@@ -1,0 +1,262 @@
+"""ripsched: the schedule-exploration model checker.
+
+What is verified here:
+
+* the pinned model registry: 4 models, their invariant ids all mapped
+  to RIPS SARIF rules, the spec document round-trips through the pin
+  file, and drift is refused with the re-pin instruction;
+* non-vacuity: every seeded mutation is DETECTED (a violation with
+  the right invariant and a replayable schedule ID) — an invariant
+  that no mutation can trip proves nothing;
+* soundness on the real protocols: every model explores clean at the
+  default preemption bound;
+* determinism: replaying a violation's schedule ID reproduces it with
+  a byte-identical trace, run to run;
+* the CLI contract: exit codes 0 (clean), 1 (violation / replay
+  reproduces), 2 (usage, spec drift, replay divergence).
+"""
+import importlib.util
+import io
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+RIPSCHED = os.path.join(REPO, "tools", "ripsched.py")
+SCHED = os.path.join(REPO, "riptide_tpu", "analysis", "sched.py")
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+sched = _load("sched_under_test", SCHED)
+ripsched = _load("ripsched_under_test", RIPSCHED)
+
+
+# -- registry + spec pin ----------------------------------------------------
+
+def test_model_registry_shape():
+    """The advertised checking surface: 4 models, 18 invariants, 8
+    seeded mutations, every invariant mapped to a RIPS SARIF rule.
+    Growing the registry is welcome — update this pin AND re-pin
+    tools/ripsched_invariants.json in the same change."""
+    assert sorted(sched.MODELS) == ["fairshare", "quarantine",
+                                    "runctx", "staging"]
+    n_inv = sum(len(m.invariants) for m in sched.MODELS.values())
+    n_mut = sum(len(m.mutations) for m in sched.MODELS.values())
+    assert n_inv == 18 and n_mut == 8
+    for spec_ in sched.MODELS.values():
+        assert spec_.targets, "every model names its target modules"
+        for inv, desc in spec_.invariants:
+            assert inv in sched._INV and desc
+            assert sched.sarif_rule_of(inv).startswith("RIPS")
+    assert len(sched.SARIF_RULES) == 6
+
+
+def test_spec_doc_matches_pinned_file():
+    with open(os.path.join(REPO, "tools",
+                           "ripsched_invariants.json")) as fobj:
+        assert json.load(fobj) == sched.spec_doc()
+
+
+def test_spec_drift_refused_with_repin_instruction(tmp_path):
+    doc = sched.spec_doc()
+    doc["models"]["fairshare"]["invariants"].pop()
+    drifted = tmp_path / "specs.json"
+    drifted.write_text(json.dumps(doc))
+    err = io.StringIO()
+    code = ripsched.run(models=["staging"], specs_path=str(drifted),
+                        out=io.StringIO(), err=err)
+    assert code == 2
+    assert "--write-specs" in err.getvalue()
+
+    # A missing pin is the same refusal...
+    err2 = io.StringIO()
+    code2 = ripsched.run(models=["staging"],
+                         specs_path=str(tmp_path / "absent.json"),
+                         out=io.StringIO(), err=err2)
+    assert code2 == 2 and "--write-specs" in err2.getvalue()
+
+    # ... and --write-specs is the remedy.
+    err3 = io.StringIO()
+    assert ripsched.run(do_write_specs=True,
+                        specs_path=str(drifted), err=err3) == 0
+    assert "pinned 4 model(s) / 18 invariant(s)" in err3.getvalue()
+    assert json.loads(drifted.read_text()) == sched.spec_doc()
+
+
+# -- non-vacuity: every mutation is detected --------------------------------
+
+MUTATIONS = [(name, mut)
+             for name, spec_ in sorted(sched.MODELS.items())
+             for mut in sorted(spec_.mutations)]
+
+
+@pytest.mark.parametrize("model,mut", MUTATIONS,
+                         ids=[f"{m}+{u}" for m, u in MUTATIONS])
+def test_every_mutation_is_detected(model, mut):
+    """Each seeded bug must produce a violation of an invariant the
+    model declares, with a schedule ID that parses back to the run."""
+    res = sched.explore_model(model, mutation=mut)
+    vio = res.violation
+    assert vio is not None, \
+        f"mutation {model}+{mut} explored {res.schedules} schedule(s) " \
+        "without tripping any invariant — the checker is vacuous for it"
+    declared = [i for i, _ in sched.MODELS[model].invariants]
+    assert vio.invariant in declared
+    assert vio.message and vio.trace_lines
+    got = sched.parse_schedule_id(vio.schedule_id)
+    assert got[0] == model and got[1] == mut
+
+
+def test_minimality_first_violation_is_preemption_minimal():
+    """Iterative bounding contract: the reported violation carries the
+    preemption count of the bound level it was found at, and replaying
+    it reproduces the same invariant."""
+    res = sched.explore_model("fairshare", mutation="drop_notify")
+    vio = res.violation
+    assert vio.preemptions <= res.bound
+    rep = sched.replay(vio.schedule_id)
+    assert rep.diverged is None
+    assert rep.violation is not None
+    assert rep.violation.invariant == vio.invariant
+
+
+# -- soundness: the real protocols explore clean ----------------------------
+
+@pytest.mark.parametrize("model", sorted(sched.MODELS))
+def test_unmutated_model_explores_clean(model):
+    res = sched.explore_model(model, max_schedules=150)
+    assert res.violation is None, res.violation.render()
+    assert res.schedules >= 1 and res.decisions >= 1
+
+
+# -- determinism ------------------------------------------------------------
+
+def test_replay_is_byte_identical_across_runs():
+    res = sched.explore_model("fairshare", mutation="drop_notify")
+    sid = res.violation.schedule_id
+    first = sched.replay(sid).render()
+    second = sched.replay(sid).render()
+    assert first == second
+    assert sid in first
+
+
+def test_malformed_schedule_id_rejected():
+    with pytest.raises(ValueError, match="malformed schedule id"):
+        sched.parse_schedule_id("bogus")
+    with pytest.raises(ValueError, match="unknown model"):
+        sched.parse_schedule_id("nosuchmodel:000")
+    with pytest.raises(ValueError, match="unknown mutation"):
+        sched.parse_schedule_id("fairshare+nosuch:000")
+    with pytest.raises(ValueError, match="malformed schedule digits"):
+        sched.parse_schedule_id("fairshare:12a")
+    with pytest.raises(ValueError):
+        sched.replay("nosuchmodel:000")
+
+
+def test_unknown_model_and_mutation_rejected():
+    with pytest.raises(ValueError, match="unknown model"):
+        sched.explore_model("nosuchmodel")
+    with pytest.raises(ValueError, match="unknown mutation"):
+        sched.explore_model("fairshare", mutation="nosuchmutation")
+
+
+# -- CLI contract -----------------------------------------------------------
+
+def test_cli_clean_explore_exit_zero():
+    out, err = io.StringIO(), io.StringIO()
+    code = ripsched.run(models=["staging", "quarantine"],
+                        out=out, err=err)
+    assert code == 0, out.getvalue() + err.getvalue()
+    assert "ripsched OK" in err.getvalue()
+    assert "zero violations" in err.getvalue()
+
+
+def test_cli_mutation_exit_one_with_minimal_schedule():
+    out, err = io.StringIO(), io.StringIO()
+    code = ripsched.run(models=["staging"], mutation="double_release",
+                        out=out, err=err)
+    assert code == 1
+    assert "invariant violation" in err.getvalue()
+    assert "--replay" in out.getvalue()
+    assert "staging+double_release:" in out.getvalue()
+
+
+def test_cli_replay_reproduces_and_exits_one():
+    res = sched.explore_model("staging", mutation="early_release")
+    sid = res.violation.schedule_id
+    out, err = io.StringIO(), io.StringIO()
+    code = ripsched.run(replay_id=sid, out=out, err=err)
+    assert code == 1
+    assert sid in out.getvalue()
+
+
+def test_cli_usage_errors_exit_two():
+    # Unknown model.
+    assert ripsched.run(models=["nosuchmodel"], out=io.StringIO(),
+                        err=io.StringIO()) == 2
+    # --mutate with more than one model.
+    assert ripsched.run(models=["staging", "fairshare"],
+                        mutation="double_release", out=io.StringIO(),
+                        err=io.StringIO()) == 2
+    # Malformed replay ID.
+    assert ripsched.run(replay_id="bogus", out=io.StringIO(),
+                        err=io.StringIO()) == 2
+
+
+def test_cli_list_enumerates_registry():
+    out = io.StringIO()
+    assert ripsched.run(list_only=True, out=out) == 0
+    text = out.getvalue()
+    for name in sched.MODELS:
+        assert f"{name}:" in text
+    for inv in sched._INV:
+        assert f"invariant {inv} " in text
+
+
+def test_cli_sarif_shape():
+    out, err = io.StringIO(), io.StringIO()
+    code = ripsched.run(models=["runctx"], fmt="sarif",
+                        out=out, err=err)
+    assert code == 0
+    doc = json.loads(out.getvalue())
+    assert doc["version"] == "2.1.0"
+    drv = doc["runs"][0]["tool"]["driver"]
+    assert drv["name"] == "ripsched"
+    assert [r["id"] for r in drv["rules"]] == \
+        sorted(r[0] for r in sched.SARIF_RULES)
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_sarif_violation_result_names_replay():
+    out, err = io.StringIO(), io.StringIO()
+    code = ripsched.run(models=["runctx"], mutation="unwrapped_worker",
+                        fmt="sarif", out=out, err=err)
+    assert code == 1
+    results = json.loads(out.getvalue())["runs"][0]["results"]
+    assert len(results) == 1
+    assert results[0]["ruleId"].startswith("RIPS")
+    assert "--replay" in results[0]["message"]["text"]
+
+
+def test_env_defaults_come_from_the_registry():
+    assert int(sched.env_default("RIPTIDE_SCHED_BOUND")) == 2
+    assert int(sched.env_default("RIPTIDE_SCHED_SEED")) == 0
+    assert sched.env_default("RIPTIDE_SCHED_REPLAY") == ""
+
+
+def test_cli_subprocess_smoke():
+    proc = subprocess.run(
+        [sys.executable, RIPSCHED, "--model", "quarantine"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ripsched OK" in proc.stderr

@@ -540,6 +540,52 @@ def test_drain_parks_job_and_restart_resumes(daemon, data_files):
         == list(range(len(DMS)))
 
 
+def test_drain_journals_park_record_into_each_jobs_journal(daemon,
+                                                           data_files):
+    # Two tenants' jobs are mid-job when the drain lands (one parked
+    # between chunks, one parked before its first turn): each job's
+    # OWN journal must receive its job_drained park record — the
+    # worker raises JobDrained under the job's RunContext, so the
+    # incident routes to that journal and never the sibling's (the
+    # attribution contract RIP012 and ripsched's runctx model guard).
+    d, base = daemon(workers=2)
+    blocker = d.queue.register("blocker", priority=-1)
+    blocker.begin(0)
+    jids = []
+    for tenant in ("alice", "bob"):
+        code, doc = _req_json(base, "/jobs", "POST",
+                              _spec(data_files, tenant=tenant))
+        assert code == 202
+        jids.append(doc["job_id"])
+    assert _spin(lambda: all(
+        d.queue.snapshot()["jobs"].get(j, {}).get("waiting")
+        for j in jids))
+    # Step: let exactly one job take a chunk turn, then the
+    # priority-(-1) blocker reclaims the device and both jobs are
+    # waiting at a gate again.
+    blocker.end(0)
+    assert _spin(lambda: d.queue.snapshot()["active"] in jids)
+    t = threading.Thread(target=lambda: blocker.begin(1), daemon=True)
+    t.start()
+    assert _spin(lambda: d.queue.snapshot()["active"] == "blocker")
+    code, doc = _req_json(base, "/drain", "POST", {})
+    assert code == 202 and doc["draining"] is True
+    blocker.end(1)
+    d.queue.unregister("blocker")
+    assert d.wait_drained(timeout=60)
+    for jid, sibling in ((jids[0], jids[1]), (jids[1], jids[0])):
+        # No terminal record: both jobs parked resumable.
+        code, doc = _req_json(base, f"/jobs/{jid}")
+        assert doc["status"] in ("pending", "running")
+        jdir = os.path.join(d.root, "jobs", jid)
+        parks = [rec for rec in SurveyJournal(jdir).incidents()
+                 if rec["incident"] == "job_drained"]
+        assert len(parks) == 1, f"{jid}: {parks}"
+        assert parks[0]["detail"]["job_id"] == jid
+        assert not any(rec["detail"].get("job_id") == sibling
+                       for rec in SurveyJournal(jdir).incidents())
+
+
 def test_concurrent_fault_attribution_is_job_scoped(daemon, data_files):
     # Two concurrent jobs, EACH with its own injected heartbeat-fsync
     # fault: every obs_write_failed incident must land in the journal

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""
+analyze: the whole static surface in ONE SARIF document.
+
+Runs the three verification passes —
+
+* **riplint** (``tools/riplint.py --format sarif``): the 14 AST/
+  call-graph analyzers against the checked-in baseline;
+* **rprove** (``tools/rprove.py --format sarif``): the semantic pass
+  over the pinned staged-program contracts (traced on the CPU
+  backend, no device execution);
+* **ripsched** (``tools/ripsched.py --format sarif``): the
+  schedule-exploration model checker over the serve-plane
+  concurrency protocols —
+
+and merges their SARIF 2.1.0 runs into one multi-run document (one
+``runs[]`` entry per tool, rule metadata preserved), the shape SARIF
+uploaders and code-scanning UIs ingest directly.
+
+Usage::
+
+    python tools/analyze.py [OUT.sarif]     # default: riptide.sarif
+
+Exit code: the MAXIMUM of the three tools' exit codes (0 all clean;
+1 any findings/violations; 2 any usage/pin-drift error), so CI can
+gate on this one entry point. ``make analyze`` runs this.
+"""
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+DEFAULT_OUT = os.path.join(REPO, "riptide.sarif")
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+# (tool name, argv tail, extra env). rprove traces jaxprs: it needs
+# the CPU backend and a clean PYTHONPATH exactly like `make prove`.
+TOOLS = (
+    ("riplint", ["riplint.py", "--format", "sarif"], {}),
+    ("rprove", ["rprove.py", "--format", "sarif"],
+     {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}),
+    ("ripsched", ["ripsched.py", "--format", "sarif"], {}),
+)
+
+
+def main(out_path=DEFAULT_OUT):
+    merged = {"version": "2.1.0", "$schema": SARIF_SCHEMA, "runs": []}
+    worst = 0
+    for name, tail, extra in TOOLS:
+        env = dict(os.environ, **extra)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, tail[0]), *tail[1:]],
+            capture_output=True, text=True, cwd=REPO, env=env)
+        worst = max(worst, proc.returncode)
+        sys.stderr.write(proc.stderr)
+        try:
+            doc = json.loads(proc.stdout)
+        except ValueError:
+            # A tool that died before emitting SARIF (pin drift, usage
+            # error) has no run to merge; its stderr + exit code carry
+            # the diagnosis.
+            print(f"analyze: {name} exited {proc.returncode} without "
+                  "SARIF output", file=sys.stderr)
+            continue
+        runs = doc.get("runs", [])
+        merged["runs"].extend(runs)
+        n_results = sum(len(r.get("results", [])) for r in runs)
+        n_rules = sum(len(r["tool"]["driver"].get("rules", []))
+                      for r in runs)
+        print(f"analyze: {name}: {n_rules} rule(s), {n_results} "
+              f"result(s), exit {proc.returncode}", file=sys.stderr)
+
+    with open(out_path, "w") as fobj:
+        json.dump(merged, fobj, indent=2)
+        fobj.write("\n")
+    total = sum(len(r.get("results", [])) for r in merged["runs"])
+    print(f"analyze: {len(merged['runs'])} run(s) merged into "
+          f"{os.path.relpath(out_path, REPO)} ({total} total "
+          f"result(s)); exit {worst}", file=sys.stderr)
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main(*sys.argv[1:2]))

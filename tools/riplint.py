@@ -22,7 +22,12 @@ Suppression, in reviewability order:
   long-lived exceptions: documented sync points, build-serialisation
   locks). ``--update-baseline`` regenerates the file, keeping the
   justifications of surviving entries; new entries get a TODO you must
-  edit before committing.
+  edit before committing. ``--prune-baseline`` is the inverse
+  maintenance pass: it rewrites the baseline keeping ONLY entries that
+  matched a finding this run, so dead justifications (code deleted
+  together with its finding, entries made redundant by a refactor)
+  cannot accrete — a pruned baseline followed by a plain run is clean
+  by construction.
 
 ``--write-env-docs`` regenerates ``docs/env_flags.md`` from the
 ``utils/envflags.py`` registry (the RIP003 analyzer fails on drift).
@@ -90,7 +95,11 @@ def _tracked_files(repo):
     pass's pinned contracts) and the package walk the rprove analysis
     sources (``analysis/jaxpr_contract.py``), so a contract edit or an
     extractor edit invalidates cached `make check` runs like any other
-    tracked change."""
+    tracked change. The same two walks cover the ripsched surface:
+    ``riptide_tpu/analysis/sched.py`` (also hashed into
+    _analyzer_digest) and the pinned ``tools/ripsched_invariants.json``
+    invariant specs — editing a model or re-pinning the spec
+    invalidates cached results."""
     out = []
     for root in ("riptide_tpu", "tools", "tests"):
         top = os.path.join(repo, root)
@@ -193,8 +202,9 @@ def _save_cached_result(repo, key, result):
 def _sarif_doc(result, analyzers, tool="riplint"):
     """One SARIF 2.1.0 run: the analyzer set as rule metadata, each new
     finding (and stale baseline entry) as a result. ``tool`` names the
-    driver — tools/rprove.py reuses this writer for the semantic pass,
-    so both analyzers publish one result format."""
+    driver — tools/rprove.py (semantic pass) and tools/ripsched.py
+    (schedule exploration) reuse this writer, so all three tools
+    publish one result format that `make analyze` merges."""
     rules = [
         {
             "id": a.rule,
@@ -284,14 +294,16 @@ def _emit(result, analyzers, fmt, out, err, cached=False):
 
 
 def run(repo=REPO, baseline_path=DEFAULT_BASELINE, analyzers=None,
-        update_baseline=False, out=sys.stdout, err=sys.stderr,
-        fmt="text", use_cache=True):
+        update_baseline=False, prune_baseline=False, out=sys.stdout,
+        err=sys.stderr, fmt="text", use_cache=True):
     """Run the analyzers; returns the process exit code."""
     analysis = load_analysis(repo)
     # Only runs of the full default analyzer set are cacheable — a
     # caller-injected subset must never poison (or be served) the
-    # default result.
-    cacheable = analyzers is None and not update_baseline and use_cache
+    # default result. Baseline-rewriting runs need the real match
+    # bookkeeping, so they are never served from (or saved to) cache.
+    cacheable = (analyzers is None and not update_baseline
+                 and not prune_baseline and use_cache)
     analyzers = analyzers or analysis.ALL_ANALYZERS
     instances = [a() if isinstance(a, type) else a for a in analyzers]
 
@@ -340,6 +352,17 @@ def run(repo=REPO, baseline_path=DEFAULT_BASELINE, analyzers=None,
         )
         return 0
 
+    if prune_baseline:
+        # Keep exactly the entries that absorbed a finding this run;
+        # everything else is dead weight (stale entries included — a
+        # prune IS the "delete the entry" remedy the stale failure
+        # asks for). New findings still fail the run below.
+        kept = [e for e in baseline.entries if e not in stale]
+        analysis.Baseline(kept, path=baseline_path).dump()
+        print(f"baseline pruned: {len(kept)} entr(y/ies) kept, "
+              f"{len(stale)} unmatched dropped", file=err)
+        stale = []
+
     result = {
         "new": [{"path": f.path, "line": f.line, "col": f.col,
                  "rule": f.rule, "message": f.message} for f in new],
@@ -367,6 +390,10 @@ def main(argv=None):
                     help="rewrite the baseline to absorb current "
                          "findings (justifications of surviving entries "
                          "are kept; new entries get a TODO)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="rewrite the baseline keeping only entries "
+                         "that matched a finding this run (drops dead "
+                         "justifications; new findings still fail)")
     ap.add_argument("--format", choices=("text", "sarif"),
                     default="text", dest="fmt",
                     help="output format: GitHub-annotation text "
@@ -396,6 +423,7 @@ def main(argv=None):
         return 0
     return run(baseline_path=args.baseline,
                update_baseline=args.update_baseline,
+               prune_baseline=args.prune_baseline,
                fmt=args.fmt, use_cache=not args.no_cache)
 
 
